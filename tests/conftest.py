@@ -1,0 +1,21 @@
+"""Force an 8-device virtual CPU platform for all tests.
+
+Runs before any test module imports jax. The axon sitecustomize may have
+already registered the TPU plugin and set JAX_PLATFORMS=axon, so we both
+scrub the env and override the jax config in-process (backends initialize
+lazily — on first jax.devices() — which happens after this).
+"""
+
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,119 @@
+"""Async snapshot tests (BASELINE.json north star: async take with
+bounded step stall; SURVEY §7 step 8)."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import PendingSnapshot, Snapshot, StateDict
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+from torchsnapshot_tpu.utils.test_utils import assert_state_dict_eq
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def test_async_take_round_trip(tmp_path):
+    params = {"w": jnp.arange(1024, dtype=jnp.float32).reshape(32, 32)}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"m": _Holder(params)})
+    assert isinstance(pending, PendingSnapshot)
+    snap = pending.wait()
+    assert pending.done()
+    target = _Holder({"w": jnp.zeros((32, 32), dtype=jnp.float32)})
+    snap.restore({"m": target})
+    np.testing.assert_array_equal(np.asarray(target.sd["w"]), np.asarray(params["w"]))
+
+
+def test_async_take_consistent_cut(tmp_path):
+    """Mutating state after async_take returns must not affect the
+    snapshot (staging = consistent cut)."""
+    state = {"w": np.arange(100, dtype=np.float32)}
+    holder = _Holder(state)
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"m": holder})
+    # Mutate immediately — before writes necessarily finished.
+    state["w"][:] = -1.0
+    snap = pending.wait()
+    target = _Holder({"w": np.zeros(100, dtype=np.float32)})
+    snap.restore({"m": target})
+    np.testing.assert_array_equal(target.sd["w"], np.arange(100, dtype=np.float32))
+
+
+def test_async_take_donation_safe(tmp_path):
+    """Buffers may be donated (deleted) by the next jit step immediately
+    after async_take returns; staging must already have happened."""
+    import jax
+
+    arr = jnp.arange(4096.0)
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"m": _Holder({"w": arr})})
+    arr.delete()  # simulate jit buffer donation
+    snap = pending.wait()
+    target = _Holder({"w": jnp.zeros(4096)})
+    snap.restore({"m": target})
+    assert float(np.asarray(target.sd["w"])[123]) == 123.0
+
+
+def test_async_take_error_surfaces():
+    class _Unpicklable:
+        def __reduce__(self):
+            raise RuntimeError("cannot pickle me")
+
+    with pytest.raises(RuntimeError, match="cannot pickle me"):
+        # Pickling happens at prepare time (synchronously).
+        Snapshot.async_take("memory://async-err", {"m": _Holder({"o": _Unpicklable()})})
+
+
+def test_async_take_multirank(tmp_path):
+    path = str(tmp_path / "snap")
+
+    def worker_take(coord, rank):
+        pending = Snapshot.async_take(
+            path, {"st": StateDict(v=rank)}, coord=coord
+        )
+        pending.wait()
+
+    store = DictStore()
+    errors = []
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, 2, timeout_s=60)
+            worker_take(coord, rank)
+        except BaseException:  # pragma: no cover
+            import traceback
+
+            errors.append(traceback.format_exc())
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[0]
+
+    def worker_restore(coord, rank):
+        app = {"st": StateDict(v=-1)}
+        Snapshot(path).restore(app, coord=coord)
+        assert app["st"]["v"] == rank
+
+    store2 = DictStore()
+    threads = [
+        threading.Thread(
+            target=lambda r=r: worker_restore(StoreCoordinator(store2, r, 2, 60), r)
+        )
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)

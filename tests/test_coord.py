@@ -1,0 +1,94 @@
+"""Coordination shim tests (reference analog: pg_wrapper usage)."""
+
+import threading
+
+import pytest
+
+from torchsnapshot_tpu.coord import (
+    DictStore,
+    FileStore,
+    NoOpCoordinator,
+    StoreCoordinator,
+    get_coordinator,
+)
+
+
+def _run_ranks(world, fn):
+    """Run fn(coordinator, rank) on `world` threads over a shared DictStore."""
+    store = DictStore()
+    results = [None] * world
+    errors = []
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=30)
+            results[rank] = fn(coord, rank)
+        except BaseException as e:
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def test_noop_coordinator():
+    c = NoOpCoordinator()
+    assert c.get_rank() == 0
+    assert c.get_world_size() == 1
+    c.barrier()
+    assert c.all_gather_object("x") == ["x"]
+    assert c.broadcast_object("y") == "y"
+
+
+def test_all_gather_object():
+    results = _run_ranks(4, lambda c, r: c.all_gather_object({"rank": r}))
+    for res in results:
+        assert res == [{"rank": i} for i in range(4)]
+
+
+def test_broadcast_object():
+    results = _run_ranks(3, lambda c, r: c.broadcast_object(f"from{r}", src=1))
+    assert results == ["from1"] * 3
+
+
+def test_barrier_then_gather_sequencing():
+    def fn(c, r):
+        c.barrier()
+        a = c.all_gather_object(r)
+        c.barrier()
+        b = c.all_gather_object(r * 10)
+        return (a, b)
+
+    for a, b in _run_ranks(3, fn):
+        assert a == [0, 1, 2]
+        assert b == [0, 10, 20]
+
+
+def test_large_object_chunking():
+    big = b"x" * (3 * 1024 * 1024)  # crosses the 512 KB chunk limit
+
+    def fn(c, r):
+        return c.all_gather_object(big if r == 0 else "small")
+
+    for res in _run_ranks(2, fn):
+        assert res[0] == big
+        assert res[1] == "small"
+
+
+def test_file_store(tmp_path):
+    store = FileStore(str(tmp_path))
+    store.set("k/with/slash", b"v1")
+    assert store.get("k/with/slash", timeout_s=5) == b"v1"
+    with pytest.raises(TimeoutError):
+        store.get("missing", timeout_s=0.2)
+
+
+def test_get_coordinator_defaults():
+    assert isinstance(get_coordinator(), NoOpCoordinator)
+    explicit = NoOpCoordinator()
+    assert get_coordinator(explicit) is explicit

@@ -1,0 +1,112 @@
+"""Codec tests (reference analog: tests/test_flatten.py:47-112)."""
+
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.flatten import flatten, inflate
+from torchsnapshot_tpu.manifest import (
+    DictEntry,
+    ListEntry,
+    OrderedDictEntry,
+    TupleEntry,
+)
+
+
+def test_flatten_basic():
+    obj = {"foo": [1, 2, OrderedDict(bar=3, baz=4)]}
+    manifest, flattened = flatten(obj, prefix="my/prefix")
+    assert isinstance(manifest["my/prefix"], DictEntry)
+    assert isinstance(manifest["my/prefix/foo"], ListEntry)
+    assert isinstance(manifest["my/prefix/foo/2"], OrderedDictEntry)
+    assert manifest["my/prefix/foo/2"].keys == ["bar", "baz"]
+    assert flattened == {
+        "my/prefix/foo/0": 1,
+        "my/prefix/foo/1": 2,
+        "my/prefix/foo/2/bar": 3,
+        "my/prefix/foo/2/baz": 4,
+    }
+
+
+def test_round_trip():
+    obj = {
+        "a": {"b": [1, 2.5, "x"], "c": OrderedDict(d=None, e=True)},
+        "f": [[1], [2, [3]]],
+        "g": (1, (2, 3)),
+    }
+    manifest, flattened = flatten(obj, prefix="p")
+    restored = inflate(manifest, flattened, prefix="p")
+    assert restored == obj
+    assert type(restored["g"]) is tuple
+    assert type(restored["g"][1]) is tuple
+    assert type(restored["a"]["c"]) is OrderedDict
+
+
+def test_round_trip_no_prefix():
+    obj = {"x": [10, 20]}
+    manifest, flattened = flatten(obj)
+    assert inflate(manifest, flattened) == obj
+
+
+def test_long_list_order():
+    # The reference scrambles lists with >= 10 elements (lexicographic sort
+    # in inflate, flatten.py:106-116); ours must not.
+    obj = {"xs": list(range(25))}
+    manifest, flattened = flatten(obj, prefix="t")
+    assert inflate(manifest, flattened, prefix="t") == obj
+
+
+def test_int_keys():
+    obj = {0: "a", 1: "b", "k": {7: [1]}}
+    manifest, flattened = flatten(obj, prefix="t")
+    restored = inflate(manifest, flattened, prefix="t")
+    assert restored == obj
+    assert set(restored.keys()) == {0, 1, "k"}
+
+
+def test_colliding_keys_not_flattened():
+    obj = {"outer": {1: "a", "1": "b"}}
+    manifest, flattened = flatten(obj, prefix="t")
+    # Colliding str() representations: the inner dict is kept as a leaf.
+    assert flattened["t/outer"] == {1: "a", "1": "b"}
+
+
+def test_slash_keys_not_flattened():
+    obj = {"outer": {"a/b": 1}}
+    manifest, flattened = flatten(obj, prefix="t")
+    assert flattened["t/outer"] == {"a/b": 1}
+
+
+def test_non_str_int_keys_not_flattened():
+    obj = {"outer": {(1, 2): "x"}}
+    _, flattened = flatten(obj, prefix="t")
+    assert flattened["t/outer"] == {(1, 2): "x"}
+
+
+def test_array_leaves_pass_through():
+    arr = np.arange(6).reshape(2, 3)
+    obj = {"w": arr, "nested": [arr]}
+    manifest, flattened = flatten(obj, prefix="t")
+    assert flattened["t/w"] is arr
+    assert flattened["t/nested/0"] is arr
+    restored = inflate(manifest, flattened, prefix="t")
+    np.testing.assert_array_equal(restored["w"], arr)
+
+
+def test_tuple_entry_type():
+    manifest, _ = flatten({"t": (1, 2)}, prefix="x")
+    assert isinstance(manifest["x/t"], TupleEntry)
+
+
+def test_empty_containers():
+    obj = {"e1": {}, "e2": [], "e3": ()}
+    manifest, flattened = flatten(obj, prefix="t")
+    restored = inflate(manifest, flattened, prefix="t")
+    assert restored == obj
+    assert type(restored["e3"]) is tuple
+
+
+def test_inflate_missing_container_entry():
+    with pytest.raises(RuntimeError, match="Container entry is absent"):
+        inflate({}, {"t/a/b": 1}, prefix="t")

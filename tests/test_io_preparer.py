@@ -1,0 +1,276 @@
+"""IO preparer tests over an 8-device virtual CPU mesh (reference analog:
+tests/test_sharded_tensor_io_preparer.py:28-230)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchsnapshot_tpu.io_preparer as io_preparer_mod
+from torchsnapshot_tpu.io_preparer import (
+    ArrayRestorePlan,
+    prepare_read,
+    prepare_write,
+)
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+)
+from torchsnapshot_tpu.scheduler import execute_read_reqs, execute_write_reqs
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+def _mesh(shape, axes):
+    devices = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devices, axes)
+
+
+def _save_and_load(value, template, storage=None):
+    storage = storage or MemoryStoragePlugin()
+    entry, write_reqs = prepare_write(value, "sf/x", rank=0)
+    asyncio.run(execute_write_reqs(write_reqs, storage, 1 << 30, rank=0))
+    out = {}
+    read_reqs, finalizers = prepare_read(entry, template, out.__setitem__
+                                         if False else (lambda v: out.update(v=v)))
+    asyncio.run(execute_read_reqs(read_reqs, storage, 1 << 30, rank=0))
+    for fin in finalizers:
+        fin()
+    return entry, out.get("v"), storage
+
+
+def test_numpy_round_trip():
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    entry, restored, _ = _save_and_load(arr, np.empty_like(arr))
+    assert isinstance(entry, ArrayEntry)
+    assert entry.dtype == "float32"
+    np.testing.assert_array_equal(restored, arr)
+
+
+def test_bfloat16_bit_exact():
+    arr = jnp.asarray(np.random.RandomState(0).randn(16, 8), dtype=jnp.bfloat16)
+    entry, restored, _ = _save_and_load(arr, arr)
+    assert entry.dtype == "bfloat16"
+    assert restored.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(restored).view(np.uint16), np.asarray(arr).view(np.uint16)
+    )
+
+
+def test_scalar_array_round_trip():
+    arr = jnp.asarray(3.5)
+    entry, restored, _ = _save_and_load(arr, arr)
+    assert restored.shape == ()
+    assert float(restored) == 3.5
+
+
+def test_primitive_inline():
+    entry, write_reqs = prepare_write(42, "sf/epoch", rank=0)
+    assert isinstance(entry, PrimitiveEntry)
+    assert write_reqs == []
+    out = {}
+    reqs, fins = prepare_read(entry, None, lambda v: out.update(v=v))
+    assert reqs == [] and fins == []
+    assert out["v"] == 42
+
+
+def test_object_round_trip():
+    value = {"nested": [1, 2], "s": "hello"}
+    entry, restored, _ = _save_and_load(value, None)
+    assert isinstance(entry, ObjectEntry)
+    assert restored == value
+
+
+def test_sharded_write_produces_chunks():
+    mesh = _mesh((8,), ("x",))
+    arr = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(16, 4),
+        NamedSharding(mesh, P("x", None)),
+    )
+    entry, write_reqs = prepare_write(arr, "sf/w", rank=0)
+    assert isinstance(entry, ShardedArrayEntry)
+    assert len(entry.shards) == 8
+    assert len(write_reqs) == 8
+    offsets = sorted(s.offsets[0] for s in entry.shards)
+    assert offsets == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_sharded_replica_dedupe():
+    # P("x", None) on a (4, 2) mesh: axis "y" replicates -> only 4 chunks.
+    mesh = _mesh((4, 2), ("x", "y"))
+    arr = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        NamedSharding(mesh, P("x", None)),
+    )
+    entry, write_reqs = prepare_write(arr, "sf/w", rank=0)
+    assert isinstance(entry, ShardedArrayEntry)
+    assert len(entry.shards) == 4
+    assert len(write_reqs) == 4
+
+
+def test_fully_replicated_is_dense():
+    mesh = _mesh((8,), ("x",))
+    arr = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32), NamedSharding(mesh, P(None))
+    )
+    entry, write_reqs = prepare_write(arr, "sf/w", rank=0)
+    assert isinstance(entry, ArrayEntry)
+    assert len(write_reqs) == 1
+
+
+@pytest.mark.parametrize(
+    "src_spec,dst_spec",
+    [
+        (P("x", None), P("x", None)),  # same sharding
+        (P("x", None), P(None, "x")),  # transpose the sharded dim
+        (P("x", None), P(None)),  # sharded -> replicated
+        (P(None), P("x", None)),  # dense -> sharded
+        (P(("x", "y"), None), P("y", "x")),  # 2D resharding
+        (P("x", "y"), P("y", None)),  # swap axes
+    ],
+)
+def test_reshard_round_trip(src_spec, dst_spec):
+    mesh = _mesh((4, 2), ("x", "y"))
+    data = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+    src = jax.device_put(data, NamedSharding(mesh, src_spec))
+    dst_template = jax.device_put(jnp.zeros_like(data), NamedSharding(mesh, dst_spec))
+    entry, restored, _ = _save_and_load(src, dst_template)
+    assert restored.sharding.is_equivalent_to(dst_template.sharding, data.ndim)
+    np.testing.assert_array_equal(np.asarray(restored), np.asarray(data))
+
+
+def test_reshard_to_smaller_mesh():
+    mesh8 = _mesh((8,), ("x",))
+    mesh2 = _mesh((2,), ("x",))
+    data = jnp.arange(64, dtype=jnp.float32).reshape(16, 4)
+    src = jax.device_put(data, NamedSharding(mesh8, P("x", None)))
+    dst_template = jax.device_put(
+        jnp.zeros_like(data), NamedSharding(mesh2, P("x", None))
+    )
+    _, restored, _ = _save_and_load(src, dst_template)
+    np.testing.assert_array_equal(np.asarray(restored), np.asarray(data))
+
+
+def test_uneven_chunk_layout_restore():
+    # Restore from a hand-built manifest whose chunks are uneven (3+3+4+6
+    # rows — e.g. saved by a world with a different subdivision policy)
+    # into an evenly-sharded template. JAX itself only produces divisible
+    # shardings, but elastic restore must accept any saved chunk layout
+    # (reference edge case: non-divisible max_shard_sz_bytes,
+    # tests/gpu_tests/test_torchrec.py:165-169).
+    from torchsnapshot_tpu.manifest import Shard
+    from torchsnapshot_tpu.serialization import array_to_bytes
+
+    data = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    storage = MemoryStoragePlugin()
+    shards = []
+    row_splits = [(0, 3), (3, 3), (6, 4), (10, 6)]
+    for start, n in row_splits:
+        loc = f"sharded/sf/w_{start}_0"
+        storage.store[loc] = bytes(array_to_bytes(data[start:start + n]))
+        shards.append(
+            Shard(
+                offsets=[start, 0],
+                sizes=[n, 4],
+                array=ArrayEntry(
+                    location=loc,
+                    serializer="raw",
+                    dtype="float32",
+                    shape=[n, 4],
+                    replicated=False,
+                ),
+            )
+        )
+    entry = ShardedArrayEntry(dtype="float32", shape=[16, 4], shards=shards)
+    mesh = _mesh((8,), ("x",))
+    template = jax.device_put(
+        jnp.zeros((16, 4), dtype=jnp.float32), NamedSharding(mesh, P("x", None))
+    )
+    out = {}
+    reqs, fins = prepare_read(entry, template, lambda v: out.update(v=v))
+    asyncio.run(execute_read_reqs(reqs, storage, 1 << 30, rank=0))
+    for fin in fins:
+        fin()
+    np.testing.assert_array_equal(np.asarray(out["v"]), data)
+
+
+def test_chunk_subdivision(monkeypatch):
+    monkeypatch.setattr(io_preparer_mod, "MAX_CHUNK_SIZE_BYTES", 64)
+    mesh = _mesh((2,), ("x",))
+    data = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)
+    src = jax.device_put(data, NamedSharding(mesh, P("x", None)))
+    entry, write_reqs = prepare_write(src, "sf/w", rank=0)
+    # Each 8x4 shard = 128 bytes -> 2 chunks each.
+    assert len(entry.shards) == 4
+    assert len(write_reqs) == 4
+    storage = MemoryStoragePlugin()
+    asyncio.run(execute_write_reqs(write_reqs, storage, 1 << 30, rank=0))
+    out = {}
+    reqs, fins = prepare_read(
+        entry, jax.device_put(jnp.zeros_like(data), NamedSharding(mesh, P(None, "x"))),
+        lambda v: out.update(v=v),
+    )
+    asyncio.run(execute_read_reqs(reqs, storage, 1 << 30, rank=0))
+    for fin in fins:
+        fin()
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.asarray(data))
+
+
+def test_ranged_reads_used_for_partial_overlap():
+    # Dense saved array restored into a row-sharded template: each shard
+    # should issue a ranged read, not read the whole object 8 times.
+    mesh = _mesh((8,), ("x",))
+    data = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)
+    entry, write_reqs = prepare_write(np.asarray(data), "sf/w", rank=0)
+    template = jax.device_put(data, NamedSharding(mesh, P("x", None)))
+    out = {}
+    reqs, fins = prepare_read(entry, template, lambda v: out.update(v=v))
+    assert len(reqs) == 8
+    assert all(r.byte_range is not None for r in reqs)
+    spans = sorted(r.byte_range for r in reqs)
+    assert spans[0][0] == 0 and spans[-1][1] == 16 * 4 * 4
+    storage = MemoryStoragePlugin()
+    asyncio.run(execute_write_reqs(write_reqs, storage, 1 << 30, rank=0))
+    asyncio.run(execute_read_reqs(reqs, storage, 1 << 30, rank=0))
+    for fin in fins:
+        fin()
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.asarray(data))
+
+
+def test_prng_key_round_trip():
+    key = jax.random.key(42)
+    entry, restored, _ = _save_and_load(key, key)
+    assert jax.dtypes.issubdtype(restored.dtype, jax.dtypes.prng_key)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(restored)),
+        np.asarray(jax.random.key_data(key)),
+    )
+    # The restored key must produce the identical stream.
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(restored, (4,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_legacy_prng_key_round_trip():
+    key = jax.random.PRNGKey(7)  # uint32 array, not a typed key
+    entry, restored, _ = _save_and_load(key, key)
+    np.testing.assert_array_equal(np.asarray(restored), np.asarray(key))
+
+
+def test_shape_mismatch_raises():
+    arr = np.zeros((4, 4), dtype=np.float32)
+    entry, _ = prepare_write(arr, "sf/w", rank=0)
+    with pytest.raises(RuntimeError, match="shape"):
+        ArrayRestorePlan(entry, np.zeros((2, 2), dtype=np.float32), lambda v: None)
+
+
+def test_int_dtypes_round_trip():
+    for dtype in [np.int8, np.uint8, np.int32, np.int64, np.uint32, np.float64]:
+        arr = np.arange(10).astype(dtype)
+        _, restored, _ = _save_and_load(arr, np.empty_like(arr))
+        np.testing.assert_array_equal(restored, arr)
+        assert restored.dtype == dtype

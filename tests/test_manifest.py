@@ -1,0 +1,172 @@
+"""Manifest + elasticity kernel tests (reference analog:
+tests/test_manifest.py:20-189)."""
+
+import pytest
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_available_entries,
+    is_replicated,
+)
+
+
+def _array(location, replicated=False):
+    return ArrayEntry(
+        location=location,
+        serializer="raw",
+        dtype="float32",
+        shape=[4, 4],
+        replicated=replicated,
+    )
+
+
+def _sharded(shards):
+    return ShardedArrayEntry(
+        dtype="float32",
+        shape=[8, 4],
+        shards=[
+            Shard(offsets=o, sizes=s, array=_array(loc)) for o, s, loc in shards
+        ],
+    )
+
+
+def _two_rank_manifest():
+    """A hand-written 2-rank manifest (reference test_manifest.py:20-85)."""
+    return {
+        "0/state": DictEntry(keys=["per_rank_x", "repl_y", "shard_w", "obj"]),
+        "0/state/per_rank_x": _array("0/state/per_rank_x"),
+        "0/state/repl_y": _array("replicated/state/repl_y", replicated=True),
+        "0/state/shard_w": _sharded(
+            [([0, 0], [4, 4], "sharded/state/shard_w_0_0")]
+        ),
+        "0/state/obj": ObjectEntry(
+            location="0/state/obj", serializer="pickle", replicated=False
+        ),
+        "0/state/prim": PrimitiveEntry(ptype="int", readable="42", replicated=True),
+        "1/state": DictEntry(keys=["per_rank_x", "repl_y", "shard_w", "obj"]),
+        "1/state/per_rank_x": _array("1/state/per_rank_x"),
+        "1/state/repl_y": _array("replicated/state/repl_y", replicated=True),
+        "1/state/shard_w": _sharded(
+            [([4, 0], [4, 4], "sharded/state/shard_w_4_0")]
+        ),
+        "1/state/obj": ObjectEntry(
+            location="1/state/obj", serializer="pickle", replicated=False
+        ),
+        "1/state/prim": PrimitiveEntry(ptype="int", readable="42", replicated=True),
+    }
+
+
+def test_yaml_round_trip():
+    metadata = SnapshotMetadata(
+        version="0.1.0", world_size=2, manifest=_two_rank_manifest()
+    )
+    restored = SnapshotMetadata.from_yaml(metadata.to_yaml())
+    assert restored.version == "0.1.0"
+    assert restored.world_size == 2
+    assert set(restored.manifest.keys()) == set(metadata.manifest.keys())
+    entry = restored.manifest["0/state/shard_w"]
+    assert isinstance(entry, ShardedArrayEntry)
+    assert entry.shards[0].offsets == [0, 0]
+    assert entry.shards[0].array.location == "sharded/state/shard_w_0_0"
+    assert isinstance(restored.manifest["0/state"], DictEntry)
+    assert restored.manifest["0/state"].keys == [
+        "per_rank_x",
+        "repl_y",
+        "shard_w",
+        "obj",
+    ]
+    prim = restored.manifest["0/state/prim"]
+    assert prim.get_value() == 42
+
+
+def test_get_available_entries_same_world():
+    manifest = _two_rank_manifest()
+    avail0 = get_available_entries(manifest, 0)
+    # Sharded: union of both ranks' shards.
+    assert len(avail0["state/shard_w"].shards) == 2
+    # Replicated + primitive: visible.
+    assert avail0["state/repl_y"].replicated
+    assert avail0["state/prim"].get_value() == 42
+    # Per-rank: own only.
+    assert avail0["state/per_rank_x"].location == "0/state/per_rank_x"
+    assert avail0["state/obj"].location == "0/state/obj"
+    avail1 = get_available_entries(manifest, 1)
+    assert avail1["state/per_rank_x"].location == "1/state/per_rank_x"
+
+
+def test_get_available_entries_larger_world():
+    """Restoring with world size > snapshot world size: rank 2 sees
+    sharded + replicated entries but no per-rank entries (reference
+    test_manifest.py:102-189)."""
+    manifest = _two_rank_manifest()
+    avail2 = get_available_entries(manifest, 2)
+    assert len(avail2["state/shard_w"].shards) == 2
+    assert "state/repl_y" in avail2
+    assert "state/prim" in avail2
+    assert "state/per_rank_x" not in avail2
+    assert "state/obj" not in avail2
+    # Containers are available to any rank.
+    assert isinstance(avail2["state"], DictEntry)
+
+
+def test_get_available_entries_double_digit_ranks():
+    """The reference parses only the first character of the rank token and
+    breaks at world size >= 10 (manifest.py:181-182); we must not."""
+    manifest = {
+        "12/state/x": _array("12/state/x"),
+    }
+    avail = get_available_entries(manifest, 12)
+    assert avail["state/x"].location == "12/state/x"
+    assert get_available_entries(manifest, 1) == {}
+
+
+def test_shard_dedupe_across_ranks():
+    # Two ranks reporting the same chunk (replicated-within-sharded case)
+    # must not duplicate it in the merged view.
+    manifest = {
+        "0/s/w": _sharded([([0, 0], [4, 4], "sharded/s/w_0_0")]),
+        "1/s/w": _sharded([([0, 0], [4, 4], "sharded/s/w_0_0")]),
+    }
+    avail = get_available_entries(manifest, 0)
+    assert len(avail["s/w"].shards) == 1
+
+
+def test_is_replicated():
+    assert is_replicated(_array("replicated/x", replicated=True))
+    assert not is_replicated(_array("0/x"))
+    assert not is_replicated(ListEntry())
+
+
+def test_primitive_entry_values():
+    for value in [0, -3, 1.5, float("inf"), True, False, None, "héllo\nworld", 1 + 2j]:
+        e = PrimitiveEntry.from_value(value)
+        restored = PrimitiveEntry(
+            ptype=e.ptype, readable=e.readable, replicated=False
+        ).get_value()
+        assert restored == value or (value != value and restored != restored)
+        assert type(restored) is type(value)
+
+
+def test_primitive_rejects_container():
+    with pytest.raises(TypeError):
+        PrimitiveEntry.from_value([1, 2])
+
+
+def test_ordered_dict_entry_roundtrip():
+    metadata = SnapshotMetadata(
+        version="0.1.0",
+        world_size=1,
+        manifest={"0/od": OrderedDictEntry(keys=["b", "a"])},
+    )
+    restored = SnapshotMetadata.from_yaml(metadata.to_yaml())
+    entry = restored.manifest["0/od"]
+    assert isinstance(entry, OrderedDictEntry)
+    assert entry.keys == ["b", "a"]

@@ -1,0 +1,155 @@
+"""Replication glob semantics over threaded multi-rank coordinators
+(reference analog: tests/test_replication_glob.py + tests/test_ddp.py)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+from torchsnapshot_tpu.manifest import get_available_entries, is_replicated
+from torchsnapshot_tpu.storage_plugin import _MEMORY_STORES
+
+
+def _run_world(world, fn):
+    store = DictStore()
+    errors = []
+    results = [None] * world
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=60)
+            results[rank] = fn(coord, rank)
+        except BaseException as e:  # pragma: no cover
+            import traceback
+
+            errors.append((rank, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed:\n{errors[0][2]}")
+    return results
+
+
+class _TestStateful:
+    """Fixed mixed-container state (reference test_replication_glob.py:22-32)."""
+
+    def __init__(self, seed=0):
+        rng = np.random.RandomState(seed)
+        self.sd = {
+            "foo": jnp.asarray(rng.randn(4, 4), dtype=jnp.float32),
+            "bar": jnp.asarray(rng.randn(2, 2), dtype=jnp.float32),
+            "baz": {"qux": jnp.asarray(rng.randn(3), dtype=jnp.float32)},
+        }
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def test_replicated_glob_all(tmp_path):
+    """replicated=["**"]: every leaf replicated, writes striped."""
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        app = {"st": _TestStateful(seed=0)}  # same state on all ranks (DDP)
+        Snapshot.take(path, app, coord=coord, replicated=["**"])
+        return None
+
+    _run_world(4, worker)
+
+    snap = Snapshot(path)
+    manifest = snap.get_manifest()
+    leaf_paths = [p for p in manifest if p.endswith(("foo", "bar", "qux"))]
+    assert leaf_paths
+    for p in manifest:
+        entry = manifest[p]
+        if hasattr(entry, "location") and p.endswith(("foo", "bar", "qux")):
+            assert entry.replicated
+            assert entry.location.startswith("replicated/")
+    # Striping: each replicated object written exactly once on disk.
+    root = tmp_path / "snap"
+    assert (root / "replicated" / "st" / "foo").exists()
+
+    # Any single process (different world size!) can restore everything.
+    target = _TestStateful(seed=9)
+    Snapshot(path).restore({"st": target})
+    np.testing.assert_array_equal(
+        np.asarray(target.sd["foo"]), np.asarray(_TestStateful(seed=0).sd["foo"])
+    )
+
+
+def test_replicated_glob_subset(tmp_path):
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        app = {"st": _TestStateful(seed=0)}
+        Snapshot.take(path, app, coord=coord, replicated=["st/baz/**"])
+
+    _run_world(2, worker)
+    manifest = Snapshot(path).get_manifest()
+    assert manifest["0/st/baz/qux"].replicated
+    assert not manifest["0/st/foo"].replicated
+    avail5 = get_available_entries(manifest, 5)
+    assert "st/baz/qux" in avail5
+    assert "st/foo" not in avail5
+
+
+def test_rank_divergent_globs_intersect(tmp_path):
+    """Ranks passing different globs degrade to the intersection
+    (reference test_replication_glob.py:103-112)."""
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        app = {"st": _TestStateful(seed=0)}
+        globs = ["st/foo", "st/bar"] if rank == 0 else ["st/foo"]
+        Snapshot.take(path, app, coord=coord, replicated=globs)
+
+    _run_world(2, worker)
+    manifest = Snapshot(path).get_manifest()
+    assert manifest["0/st/foo"].replicated
+    assert not manifest["0/st/bar"].replicated
+
+
+def test_per_rank_state(tmp_path):
+    """Without replication, each rank's state is private and restorable
+    only at the same world size (reference test_ddp.py semantics)."""
+    path = str(tmp_path / "snap")
+
+    def take_worker(coord, rank):
+        app = {"st": StateDict(val=rank * 100)}
+        Snapshot.take(path, app, coord=coord)
+
+    _run_world(3, take_worker)
+
+    def restore_worker(coord, rank):
+        app = {"st": StateDict(val=-1)}
+        Snapshot(path).restore(app, coord=coord)
+        return app["st"]["val"]
+
+    assert _run_world(3, restore_worker) == [0, 100, 200]
+
+
+def test_metadata_world_size(tmp_path):
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        Snapshot.take(path, {"st": StateDict(x=1)}, coord=coord)
+
+    _run_world(2, worker)
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+    meta_file = tmp_path / "snap" / SNAPSHOT_METADATA_FNAME
+    assert meta_file.exists()
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    md = SnapshotMetadata.from_yaml(meta_file.read_text())
+    assert md.world_size == 2

@@ -1,0 +1,153 @@
+"""Overlap/subdivision math tests (reference analog:
+tests/test_sharded_tensor_io_preparer.py subdivision cases)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.resharding import (
+    compute_overlap,
+    contiguous_byte_range,
+    index_to_offsets_sizes,
+    subdivide,
+)
+
+
+def test_overlap_disjoint():
+    assert compute_overlap([0, 0], [4, 4], [4, 0], [4, 4]) is None
+    assert compute_overlap([0, 0], [4, 4], [0, 4], [4, 4]) is None
+
+
+def test_overlap_identity():
+    ov = compute_overlap([0, 0], [4, 4], [0, 0], [4, 4])
+    assert ov.chunk_slices == (slice(0, 4), slice(0, 4))
+    assert ov.target_slices == (slice(0, 4), slice(0, 4))
+    assert ov.sizes == (4, 4)
+
+
+def test_overlap_partial():
+    # chunk rows [2, 6), target rows [4, 8): overlap rows [4, 6).
+    ov = compute_overlap([2, 0], [4, 4], [4, 0], [4, 4])
+    assert ov.chunk_slices == (slice(2, 4), slice(0, 4))
+    assert ov.target_slices == (slice(0, 2), slice(0, 4))
+    assert ov.offsets == (4, 0)
+
+
+def test_overlap_0d():
+    ov = compute_overlap([], [], [], [])
+    assert ov.chunk_slices == ()
+    assert ov.target_slices == ()
+
+
+def test_overlap_semantics_by_simulation():
+    # Random boxes: copying chunk[chunk_slices] -> target[target_slices]
+    # must reproduce np slicing semantics exactly.
+    rng = np.random.RandomState(0)
+    global_arr = rng.rand(16, 12)
+    for _ in range(100):
+        co = [rng.randint(0, 12), rng.randint(0, 8)]
+        cs = [rng.randint(1, 16 - co[0] + 1), rng.randint(1, 12 - co[1] + 1)]
+        to = [rng.randint(0, 12), rng.randint(0, 8)]
+        ts = [rng.randint(1, 16 - to[0] + 1), rng.randint(1, 12 - to[1] + 1)]
+        chunk = global_arr[co[0]:co[0] + cs[0], co[1]:co[1] + cs[1]]
+        target = np.zeros(ts)
+        ov = compute_overlap(co, cs, to, ts)
+        if ov is None:
+            continue
+        target[ov.target_slices] = chunk[ov.chunk_slices]
+        expect = global_arr[to[0]:to[0] + ts[0], to[1]:to[1] + ts[1]]
+        mask = np.zeros(ts, dtype=bool)
+        mask[ov.target_slices] = True
+        np.testing.assert_array_equal(target[mask], expect[mask])
+
+
+def test_index_to_offsets_sizes():
+    off, sz = index_to_offsets_sizes((slice(2, 6), slice(None)), [8, 4])
+    assert off == [2, 0]
+    assert sz == [4, 4]
+    off, sz = index_to_offsets_sizes((), [])
+    assert off == []
+    assert sz == []
+    # Trailing dims not covered by the index are full.
+    off, sz = index_to_offsets_sizes((slice(0, 2),), [4, 6])
+    assert off == [0, 0]
+    assert sz == [2, 6]
+
+
+def test_subdivide_no_split():
+    assert subdivide([0], [10], 4, 1000) == [([0], [10])]
+
+
+def test_subdivide_even():
+    chunks = subdivide([0, 0], [8, 4], itemsize=4, max_chunk_bytes=64)
+    # 8*4*4 = 128 bytes -> 2 chunks of 4 rows.
+    assert chunks == [([0, 0], [4, 4]), ([4, 0], [4, 4])]
+
+
+def test_subdivide_uneven_boundary():
+    # 7 rows, max 2 rows worth of bytes per chunk: 3+2+2 or similar cover.
+    chunks = subdivide([3, 0], [7, 4], itemsize=4, max_chunk_bytes=32)
+    total = 0
+    pos = 3
+    for off, sz in chunks:
+        assert off[0] == pos
+        assert sz[1] == 4
+        pos += sz[0]
+        total += sz[0]
+    assert total == 7
+
+
+def test_subdivide_covers_and_respects_cap_various():
+    rng = np.random.RandomState(1)
+    for _ in range(50):
+        sizes = [int(rng.randint(1, 20)), int(rng.randint(1, 20))]
+        cap = int(rng.randint(8, 256))
+        chunks = subdivide([0, 0], sizes, itemsize=4, max_chunk_bytes=cap)
+        seen = np.zeros(sizes, dtype=int)
+        for off, sz in chunks:
+            seen[off[0]:off[0] + sz[0], off[1]:off[1] + sz[1]] += 1
+        assert (seen == 1).all()
+
+
+def test_subdivide_scalar():
+    assert subdivide([], [], 8, 4) == [([], [])]
+
+
+def test_contiguous_byte_range_full():
+    assert contiguous_byte_range([4, 4], (slice(0, 4), slice(0, 4)), 4) == (0, 64)
+
+
+def test_contiguous_byte_range_rows():
+    # Rows [1,3) of a (4,4) chunk: bytes [16, 48) with itemsize 4.
+    assert contiguous_byte_range([4, 4], (slice(1, 3), slice(0, 4)), 4) == (16, 48)
+
+
+def test_contiguous_byte_range_column_not_contiguous():
+    assert contiguous_byte_range([4, 4], (slice(0, 4), slice(0, 2)), 4) is None
+
+
+def test_contiguous_byte_range_single_row_cols():
+    # One row, partial cols: contiguous.
+    assert contiguous_byte_range([4, 4], (slice(2, 3), slice(1, 3)), 4) == (
+        (2 * 4 + 1) * 4,
+        (2 * 4 + 3) * 4,
+    )
+
+
+def test_contiguous_byte_range_matches_numpy():
+    rng = np.random.RandomState(2)
+    for _ in range(200):
+        shape = [int(rng.randint(1, 6)) for _ in range(rng.randint(1, 4))]
+        arr = np.arange(int(np.prod(shape)), dtype=np.int32).reshape(shape)
+        slices = tuple(
+            slice(a, a + int(rng.randint(1, s - a + 1)))
+            for s, a in ((s, int(rng.randint(0, s))) for s in shape)
+        )
+        rng_bytes = contiguous_byte_range(shape, slices, 4)
+        sel = arr[slices]
+        if rng_bytes is None:
+            continue
+        start, end = rng_bytes
+        flat = arr.tobytes()[start:end]
+        np.testing.assert_array_equal(
+            np.frombuffer(flat, dtype=np.int32).reshape(sel.shape), sel
+        )

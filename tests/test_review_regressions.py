@@ -1,0 +1,196 @@
+"""Regression tests for code-review findings on the initial implementation."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def _run_world(world, fn, store=None):
+    store = store or DictStore()
+    errors = []
+    results = [None] * world
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=60)
+            results[rank] = fn(coord, rank)
+        except BaseException:  # pragma: no cover
+            import traceback
+
+            errors.append((rank, traceback.format_exc()))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed:\n{errors[0][1]}")
+    return results
+
+
+def test_numpy_scalar_leaves(tmp_path):
+    """np.float64 subclasses float; must route to the array path, not
+    PrimitiveEntry (which would raise)."""
+    app = {
+        "s": _Holder(
+            {
+                "best": np.float64(0.93),
+                "count": np.int64(7),
+                "flag": np.bool_(True),
+                "f32": np.float32(1.25),
+            }
+        )
+    }
+    Snapshot.take(str(tmp_path / "snap"), app)
+    target = _Holder(
+        {
+            "best": np.float64(0),
+            "count": np.int64(0),
+            "flag": np.bool_(False),
+            "f32": np.float32(0),
+        }
+    )
+    Snapshot(str(tmp_path / "snap")).restore({"s": target})
+    assert float(target.sd["best"]) == 0.93
+    assert int(target.sd["count"]) == 7
+    assert bool(target.sd["flag"]) is True
+    assert float(target.sd["f32"]) == 1.25
+
+
+def test_same_coordinator_two_takes(tmp_path):
+    """Key generations must not collide across successive operations on
+    one coordinator (persistent-store key reuse)."""
+
+    def worker(coord, rank):
+        Snapshot.take(str(tmp_path / "s1"), {"a": StateDict(x=rank)}, coord=coord)
+        Snapshot.take(str(tmp_path / "s2"), {"a": StateDict(x=rank + 10)}, coord=coord)
+        app = {"a": StateDict(x=-1)}
+        Snapshot(str(tmp_path / "s2")).restore(app, coord=coord)
+        assert app["a"]["x"] == rank + 10
+
+    _run_world(2, worker)
+
+
+def test_replicated_striping_with_divergent_keys(tmp_path):
+    """Round-robin ownership must be computed over the (rank-identical)
+    replicated path set, not each rank's full flattened list — otherwise a
+    replicated object can end up written by nobody."""
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        sd = {"shared": np.arange(4, dtype=np.float32)}
+        if rank == 1:
+            # Extra per-rank keys sorting *before* "shared" shift rank 1's
+            # flattened index of the replicated path.
+            sd["aaa_extra0"] = np.zeros(1, dtype=np.float32)
+            sd["aab_extra1"] = np.zeros(1, dtype=np.float32)
+        Snapshot.take(path, {"st": _Holder(sd)}, coord=coord, replicated=["st/shared"])
+
+    _run_world(2, worker)
+    # The replicated object must exist and be restorable by a fresh process.
+    assert (tmp_path / "snap" / "replicated" / "st" / "shared").exists()
+    target = _Holder({"shared": np.zeros(4, dtype=np.float32)})
+    Snapshot(path).restore({"st": target})
+    np.testing.assert_array_equal(target.sd["shared"], np.arange(4, dtype=np.float32))
+
+
+def test_per_rank_divergent_container_keys(tmp_path):
+    """Each rank's dict key set may differ; get_available_entries must
+    resolve containers per-rank so inflation matches the local structure."""
+    path = str(tmp_path / "snap")
+
+    def take_worker(coord, rank):
+        Snapshot.take(
+            path,
+            {"st": _Holder({"cursor": {f"worker{rank}": rank * 11}})},
+            coord=coord,
+        )
+
+    _run_world(2, take_worker)
+
+    def restore_worker(coord, rank):
+        target = _Holder({"cursor": {f"worker{rank}": -1}})
+        Snapshot(path).restore({"st": target}, coord=coord)
+        assert target.sd["cursor"] == {f"worker{rank}": rank * 11}
+
+    _run_world(2, restore_worker)
+
+
+def test_sharded_prng_key_array(tmp_path):
+    """Partitioned typed PRNG key arrays must take the sharded path and
+    round-trip exactly."""
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    keys = jax.random.split(jax.random.key(0), 8)
+    sharded_keys = jax.device_put(keys, NamedSharding(mesh, P("x")))
+    assert not sharded_keys.is_fully_replicated
+
+    holder = _Holder({"keys": sharded_keys})
+    Snapshot.take(str(tmp_path / "snap"), {"st": holder})
+
+    manifest = Snapshot(str(tmp_path / "snap")).get_manifest()
+    from torchsnapshot_tpu.manifest import ShardedArrayEntry
+
+    entry = manifest["0/st/keys"]
+    assert isinstance(entry, ShardedArrayEntry)
+    assert entry.prng_impl is not None
+
+    template = jax.device_put(jax.random.split(jax.random.key(9), 8),
+                              NamedSharding(mesh, P("x")))
+    target = _Holder({"keys": template})
+    Snapshot(str(tmp_path / "snap")).restore({"st": target})
+    restored = target.sd["keys"]
+    assert jax.dtypes.issubdtype(restored.dtype, jax.dtypes.prng_key)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(restored)),
+        np.asarray(jax.random.key_data(keys)),
+    )
+    # Streams must be identical.
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.normal(restored[3], (4,))),
+        np.asarray(jax.random.normal(keys[3], (4,))),
+    )
+
+
+def test_async_retake_same_path(tmp_path):
+    """A second async_take to the same path must not be confused by the
+    first take's completion markers."""
+    path = str(tmp_path / "snap")
+    p1 = Snapshot.async_take(path, {"s": _Holder({"w": np.arange(4.0)})})
+    p1.wait()
+    p2 = Snapshot.async_take(path, {"s": _Holder({"w": np.arange(4.0) * 2})})
+    p2.wait()
+    target = _Holder({"w": np.zeros(4)})
+    Snapshot(path).restore({"s": target})
+    np.testing.assert_array_equal(target.sd["w"], np.arange(4.0) * 2)
+
+
+def test_async_budget_respected(tmp_path, monkeypatch):
+    """Async writes go through the budgeted pipeline (no unbounded
+    simultaneous staging)."""
+    monkeypatch.setenv("TPUSNAPSHOT_PER_RANK_MEMORY_BUDGET_BYTES", "4096")
+    arrays = {f"w{i}": jnp.arange(256, dtype=jnp.float32) for i in range(20)}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"s": _Holder(arrays)})
+    snap = pending.wait()
+    target = _Holder({k: jnp.zeros(256, dtype=jnp.float32) for k in arrays})
+    snap.restore({"s": target})
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(np.asarray(target.sd[k]), np.asarray(v))

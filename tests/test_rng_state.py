@@ -1,0 +1,58 @@
+"""RNG invariant tests (reference analog: tests/test_rng_state.py)."""
+
+import random
+
+import numpy as np
+
+from torchsnapshot_tpu import RNGState, Snapshot, StateDict
+
+
+class StatefulWithRNGSideEffect:
+    """state_dict() perturbs host RNG (reference test_rng_state.py:16-23)."""
+
+    def state_dict(self):
+        np.random.rand(10)
+        random.random()
+        return {"noop": 0}
+
+    def load_state_dict(self, state_dict):
+        np.random.rand(10)
+        random.random()
+
+
+def test_rng_state_take_restore_identical(tmp_path):
+    """The RNG stream observed after take() must equal the stream observed
+    after restore() — even when other statefuls perturb RNG inside their
+    state_dict() (reference snapshot.py:174-191, 216-221)."""
+    app_state = {"rng": RNGState(), "evil": StatefulWithRNGSideEffect()}
+    np.random.seed(42)
+    random.seed(42)
+    snap = Snapshot.take(str(tmp_path / "snap"), app_state)
+    after_take_np = np.random.rand(5)
+    after_take_py = [random.random() for _ in range(5)]
+
+    # Scramble RNG, then restore: draws must match the post-take draws.
+    np.random.seed(777)
+    random.seed(777)
+    snap.restore({"rng": RNGState(), "evil": StatefulWithRNGSideEffect()})
+    np.testing.assert_array_equal(np.random.rand(5), after_take_np)
+    assert [random.random() for _ in range(5)] == after_take_py
+
+
+def test_rng_round_trip_plain(tmp_path):
+    np.random.seed(1)
+    random.seed(1)
+    snap = Snapshot.take(str(tmp_path / "snap"), {"rng": RNGState()})
+    expected = np.random.rand(3)
+    np.random.seed(2)
+    snap.restore({"rng": RNGState()})
+    np.testing.assert_array_equal(np.random.rand(3), expected)
+
+
+def test_two_rng_states_rejected(tmp_path):
+    import pytest
+
+    with pytest.raises(RuntimeError, match="at most one RNGState"):
+        Snapshot.take(
+            str(tmp_path / "snap"), {"a": RNGState(), "b": RNGState()}
+        )

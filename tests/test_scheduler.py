@@ -1,0 +1,129 @@
+"""Scheduler pipeline tests (reference analog: scheduler.py behavior)."""
+
+import asyncio
+
+import pytest
+
+from torchsnapshot_tpu.coord import NoOpCoordinator
+from torchsnapshot_tpu.io_types import (
+    BufferConsumer,
+    BufferStager,
+    IOReq,
+    ReadReq,
+    WriteReq,
+)
+from torchsnapshot_tpu.scheduler import (
+    execute_read_reqs,
+    execute_write_reqs,
+    get_local_world_size,
+    get_process_memory_budget_bytes,
+)
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+class _Stager(BufferStager):
+    def __init__(self, payload: bytes, tracker=None):
+        self.payload = payload
+        self.tracker = tracker
+
+    async def stage_buffer(self, executor=None):
+        if self.tracker is not None:
+            self.tracker["staging"] += 1
+            self.tracker["max_staging"] = max(
+                self.tracker["max_staging"], self.tracker["staging"]
+            )
+            await asyncio.sleep(0.005)
+            self.tracker["staging"] -= 1
+        return self.payload
+
+    def get_staging_cost_bytes(self) -> int:
+        return len(self.payload)
+
+
+class _Consumer(BufferConsumer):
+    def __init__(self, sink, key):
+        self.sink = sink
+        self.key = key
+
+    async def consume_buffer(self, buf, executor=None):
+        self.sink[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return 64
+
+
+def test_write_read_round_trip():
+    storage = MemoryStoragePlugin()
+    payloads = {f"p{i}": bytes([i]) * (i + 1) for i in range(50)}
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=_Stager(v)) for k, v in payloads.items()
+    ]
+    written = asyncio.run(
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes=1 << 20, rank=0)
+    )
+    assert written == sum(len(v) for v in payloads.values())
+    assert storage.store == payloads
+
+    sink = {}
+    read_reqs = [
+        ReadReq(path=k, buffer_consumer=_Consumer(sink, k)) for k in payloads
+    ]
+    read = asyncio.run(
+        execute_read_reqs(read_reqs, storage, memory_budget_bytes=1 << 20, rank=0)
+    )
+    assert read == written
+    assert sink == payloads
+
+
+def test_budget_limits_concurrent_staging():
+    storage = MemoryStoragePlugin()
+    tracker = {"staging": 0, "max_staging": 0}
+    # 100-byte buffers with a 250-byte budget: at most 2 staged at once.
+    write_reqs = [
+        WriteReq(path=f"p{i}", buffer_stager=_Stager(b"x" * 100, tracker))
+        for i in range(10)
+    ]
+    asyncio.run(
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes=250, rank=0)
+    )
+    assert tracker["max_staging"] <= 2
+    assert len(storage.store) == 10
+
+
+def test_over_budget_buffer_still_progresses():
+    storage = MemoryStoragePlugin()
+    write_reqs = [WriteReq(path="big", buffer_stager=_Stager(b"x" * 1000))]
+    written = asyncio.run(
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes=10, rank=0)
+    )
+    assert written == 1000
+
+
+def test_write_error_propagates():
+    class _FailingStorage(MemoryStoragePlugin):
+        async def write(self, io_req: IOReq) -> None:
+            raise IOError("disk on fire")
+
+    with pytest.raises(IOError, match="disk on fire"):
+        asyncio.run(
+            execute_write_reqs(
+                [WriteReq(path="p", buffer_stager=_Stager(b"x"))],
+                _FailingStorage(),
+                memory_budget_bytes=1 << 20,
+                rank=0,
+            )
+        )
+
+
+def test_memory_budget_env_override(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_PER_RANK_MEMORY_BUDGET_BYTES", "12345")
+    assert get_process_memory_budget_bytes(NoOpCoordinator()) == 12345
+
+
+def test_memory_budget_default():
+    budget = get_process_memory_budget_bytes(NoOpCoordinator())
+    assert 0 < budget <= 32 * 1024 * 1024 * 1024
+
+
+def test_local_world_size():
+    assert get_local_world_size(NoOpCoordinator()) == 1

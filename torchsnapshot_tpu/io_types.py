@@ -1,0 +1,87 @@
+"""IO interfaces shared by the scheduler, preparers, and storage plugins.
+
+TPU-native analog of reference torchsnapshot/io_types.py:15-71.
+
+- ``BufferStager`` — produces the payload for one storage write; staging is
+  where device→host (HBM→RAM) transfer and serialization happen, off the
+  critical path inside a thread executor.
+- ``BufferConsumer`` — absorbs the payload of one storage read; consuming
+  is where deserialization and host→device placement happen.
+- ``WriteReq``/``ReadReq`` pair a storage path with a stager/consumer.
+- ``IOReq`` is the unit handed to a ``StoragePlugin``.
+- ``StoragePlugin`` — async write/read/delete + sync close; concrete
+  backends live in ``torchsnapshot_tpu.storage_plugins``.
+"""
+
+import abc
+import io
+from concurrent.futures import Executor
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+BufferType = Union[bytes, bytearray, memoryview]
+
+
+class BufferStager(abc.ABC):
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        """Produce the payload bytes (device→host copy + serialize)."""
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Peak host memory charged against the budget while staging."""
+
+
+class BufferConsumer(abc.ABC):
+    @abc.abstractmethod
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        """Absorb the payload bytes (deserialize + host→device copy)."""
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        """Peak host memory charged against the budget while consuming."""
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    # Byte range within the stored object ([start, end)); None = whole
+    # object. Enables partial reads of large chunks during resharding.
+    byte_range: Optional[tuple] = None
+
+
+@dataclass
+class IOReq:
+    path: str
+    buf: io.BytesIO = field(default_factory=io.BytesIO)
+    byte_range: Optional[tuple] = None
+    # Write-path payload. When set, plugins write `data` directly (zero-copy
+    # from the staged host buffer) instead of draining `buf`.
+    data: Optional[BufferType] = None
+
+
+class StoragePlugin(abc.ABC):
+    @abc.abstractmethod
+    async def write(self, io_req: IOReq) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def read(self, io_req: IOReq) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        ...

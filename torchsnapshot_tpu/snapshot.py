@@ -1,0 +1,756 @@
+"""Snapshot: take/restore orchestration.
+
+TPU-native analog of reference torchsnapshot/snapshot.py:64-527. The same
+four-phase protocol as the reference, re-based onto JAX:
+
+``take`` (reference snapshot.py:134-224):
+  1. collate the snapshot path across processes (broadcast from rank 0);
+  2. capture + save host RNG state *first*, re-load it after all other
+     statefuls so their ``state_dict()`` side effects don't leak
+     (snapshot.py:174-191, 216-221);
+  3. gather the global key set, then save statefuls in the same order on
+     every process with barriers in between — ``state_dict()`` may run
+     collectives, and ordered iteration prevents interleaving
+     (snapshot.py:193-209);
+  4. all-gather per-process manifests; rank 0 writes the YAML metadata
+     (the commit point — a snapshot without metadata is invisible).
+
+``restore`` (reference snapshot.py:226-269): read metadata, resolve the
+rank-local view with ``get_available_entries`` (elasticity), load
+statefuls in global key order with barriers, RNG state restored last.
+
+Value categories (reference snapshot.py:79-113):
+  - **sharded** — partitioned ``jax.Array``s; always elastic.
+  - **replicated** — opt-in via glob patterns on logical paths; writes are
+    striped round-robin across processes (snapshot.py:313-359); elastic.
+  - **per-rank** — everything else; restore requires the same world size.
+
+Async snapshots (beyond strict parity; BASELINE.json north star): with
+``Snapshot.async_take`` the device→host staging happens synchronously (a
+consistent cut of training state) and storage writes + manifest exchange
+drain on a background thread. Coordination traffic rides the KV store
+(DCN), never XLA collectives, so background coordination cannot deadlock
+with the training step's ICI collectives.
+"""
+
+import asyncio
+import fnmatch
+import logging
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .coord import Coordinator, get_coordinator
+from .flatten import flatten, inflate
+from .io_preparer import prepare_read, prepare_write
+from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq
+from .manifest import (
+    DictEntry,
+    Entry,
+    ListEntry,
+    Manifest,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_available_entries,
+    is_replicated,
+)
+from .rng_state import RNGState
+from .scheduler import (
+    execute_read_reqs,
+    execute_write_reqs,
+    get_process_memory_budget_bytes,
+)
+from .stateful import AppState, Stateful
+from .storage_plugin import url_to_storage_plugin
+from .version import __version__
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
+
+
+class Snapshot:
+    """A handle to a snapshot location.
+
+    Cheap by design: holds only the path and coordinator; all metadata
+    reads are deferred to :meth:`restore` (reference snapshot.py:115-132).
+    """
+
+    def __init__(self, path: str, coord: Optional[Coordinator] = None) -> None:
+        self.path = path
+        self._coord = coord
+        self._metadata_cache: Optional[SnapshotMetadata] = None
+
+    # ------------------------------------------------------------------ take
+
+    @classmethod
+    def take(
+        cls,
+        path: str,
+        app_state: AppState,
+        coord: Optional[Coordinator] = None,
+        replicated: Optional[List[str]] = None,
+    ) -> "Snapshot":
+        """Persist ``app_state`` to ``path``; returns a handle.
+
+        Reference analog: snapshot.py:134-224.
+        """
+        coordinator = get_coordinator(coord)
+        path = cls._collate_path(coordinator, path)
+        storage = url_to_storage_plugin(path)
+        try:
+            cls._take_impl(
+                path=path,
+                app_state=app_state,
+                coordinator=coordinator,
+                storage=storage,
+                replicated=replicated or [],
+                background=None,
+            )
+        finally:
+            storage.close()
+        return cls(path=path, coord=coord)
+
+    @classmethod
+    def async_take(
+        cls,
+        path: str,
+        app_state: AppState,
+        coord: Optional[Coordinator] = None,
+        replicated: Optional[List[str]] = None,
+    ) -> "PendingSnapshot":
+        """Take a snapshot with storage writes overlapped with training.
+
+        Device→host staging runs synchronously so the caller gets back a
+        consistent cut of the state; writes, the manifest exchange, and the
+        metadata commit drain on a background thread. Call ``.wait()`` (or
+        check ``.done()``) before depending on the snapshot.
+        """
+        coordinator = get_coordinator(coord)
+        path = cls._collate_path(coordinator, path)
+        storage = url_to_storage_plugin(path)
+        background = _BackgroundTake()
+        try:
+            cls._take_impl(
+                path=path,
+                app_state=app_state,
+                coordinator=coordinator,
+                storage=storage,
+                replicated=replicated or [],
+                background=background,
+            )
+        except BaseException:
+            storage.close()
+            raise
+        return PendingSnapshot(
+            path=path, coord=coord, background=background, storage=storage
+        )
+
+    @classmethod
+    def _take_impl(
+        cls,
+        path: str,
+        app_state: AppState,
+        coordinator: Coordinator,
+        storage: StoragePlugin,
+        replicated: List[str],
+        background: Optional["_BackgroundTake"],
+    ) -> None:
+        app_state = dict(app_state)
+        rank = coordinator.get_rank()
+        rng_key, rng_stateful = _pop_rng_state(app_state)
+        rng_captured: Optional[Dict[str, Any]] = None
+
+        manifest: Manifest = {}
+        pending_write_reqs: List[WriteReq] = []
+
+        # Save the RNG stateful first so later state_dict() calls cannot
+        # perturb what the snapshot records (reference snapshot.py:174-191).
+        # Every rank participates in every per-key negotiation collective —
+        # key sets may diverge across ranks (a rank without the stateful
+        # contributes an empty state dict), and a collective issued by only
+        # some ranks would desynchronize the coordinator.
+        global_rng_keys = _gather_keys(
+            coordinator, [rng_key] if rng_stateful is not None else []
+        )
+        if rng_stateful is not None:
+            rng_captured = rng_stateful.state_dict()
+        for key in global_rng_keys:
+            _save_stateful(
+                key=key,
+                state_dict=rng_captured if key == rng_key else None,
+                coordinator=coordinator,
+                rank=rank,
+                replicated_globs=replicated,
+                manifest_out=manifest,
+                write_reqs_out=pending_write_reqs,
+            )
+
+        global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
+        for key in global_keys:
+            stateful = app_state.get(key)
+            _save_stateful(
+                key=key,
+                state_dict=stateful.state_dict() if stateful is not None else None,
+                coordinator=coordinator,
+                rank=rank,
+                replicated_globs=replicated,
+                manifest_out=manifest,
+                write_reqs_out=pending_write_reqs,
+            )
+            coordinator.barrier()
+
+        budget = get_process_memory_budget_bytes(coordinator)
+
+        if background is None:
+            asyncio.run(
+                execute_write_reqs(pending_write_reqs, storage, budget, rank)
+            )
+            # The manifest all-gather doubles as the completion barrier:
+            # rank 0 holds every rank's manifest only after every rank
+            # finished its writes, so metadata-last ordering is guaranteed.
+            take_id = coordinator.broadcast_object(
+                uuid.uuid4().hex if rank == 0 else None, src=0
+            )
+            metadata = _gather_manifest(coordinator, manifest, take_id=take_id)
+            if rank == 0:
+                _write_snapshot_metadata(storage, metadata)
+            coordinator.barrier()
+        else:
+            # Async take. All *collectives* run in the foreground (they are
+            # kilobytes over the KV store); only storage writes drain in the
+            # background. Cross-rank write completion is signalled through
+            # storage markers, NOT coordinator collectives — a background
+            # thread must never race the coordinator against foreground
+            # snapshot operations.
+            #
+            # Consistency: every buffer is staged to host *now*. Holding
+            # device arrays lazily would break under jit buffer donation
+            # (the next training step deletes the snapshotted buffers), so
+            # the stall equals one HBM→host copy of the app state and host
+            # RAM must fit the per-host checkpoint size (a warning is
+            # logged when it exceeds the memory budget). Use Snapshot.take
+            # when host memory is the constraint.
+            _prestage_write_reqs(pending_write_reqs, budget)
+
+            # Per-take nonce: completion markers and the metadata document
+            # from concurrent/previous takes to the same path must never
+            # satisfy this take's polls (the nonce is recorded as the
+            # metadata's take_id, making successive takes' YAML distinct
+            # even when their manifests are byte-identical).
+            nonce = coordinator.broadcast_object(
+                uuid.uuid4().hex if rank == 0 else None, src=0
+            )
+            metadata = _gather_manifest(coordinator, manifest, take_id=nonce)
+            background.expected_metadata_yaml = metadata.to_yaml()
+            world_size = coordinator.get_world_size()
+
+            def _drain() -> None:
+                async def _run() -> None:
+                    await execute_write_reqs(
+                        pending_write_reqs, storage, budget, rank
+                    )
+                    marker = IOReq(path=f".completed/{nonce}/{rank}")
+                    marker.buf.write(b"1")
+                    await storage.write(marker)
+                    if rank == 0:
+                        await _wait_for_completion_markers(
+                            storage, world_size, nonce
+                        )
+                        await _awrite_snapshot_metadata(storage, metadata)
+                        for r in range(world_size):
+                            try:
+                                await storage.delete(f".completed/{nonce}/{r}")
+                            except Exception:
+                                pass  # best-effort cleanup
+
+                asyncio.run(_run())
+
+            background.start(_drain)
+
+        # Re-load the captured RNG state: the snapshot and the continuing
+        # program observe identical RNG streams (reference
+        # snapshot.py:216-221).
+        if rng_stateful is not None and rng_captured is not None:
+            rng_stateful.load_state_dict(rng_captured)
+
+    # --------------------------------------------------------------- restore
+
+    def restore(
+        self, app_state: AppState, coord: Optional[Coordinator] = None
+    ) -> None:
+        """Restore ``app_state`` in place from this snapshot.
+
+        Reference analog: snapshot.py:226-269.
+        """
+        coordinator = get_coordinator(coord if coord is not None else self._coord)
+        rank = coordinator.get_rank()
+        storage = url_to_storage_plugin(self.path)
+        try:
+            metadata = self._read_snapshot_metadata(storage)
+            available = get_available_entries(metadata.manifest, rank)
+
+            app_state = dict(app_state)
+            rng_key, rng_stateful = _pop_rng_state(app_state)
+
+            global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
+            budget = get_process_memory_budget_bytes(coordinator)
+            for key in global_keys:
+                stateful = app_state.get(key)
+                if stateful is not None:
+                    _load_stateful(
+                        key=key,
+                        stateful=stateful,
+                        available=available,
+                        storage=storage,
+                        budget=budget,
+                        rank=rank,
+                        world_size=coordinator.get_world_size(),
+                        snapshot_world_size=metadata.world_size,
+                    )
+                coordinator.barrier()
+
+            # RNG state is restored last so that no other stateful's
+            # load_state_dict() perturbs it (reference snapshot.py:258-268).
+            if rng_stateful is not None:
+                _load_stateful(
+                    key=rng_key,
+                    stateful=rng_stateful,
+                    available=available,
+                    storage=storage,
+                    budget=budget,
+                    rank=rank,
+                    world_size=coordinator.get_world_size(),
+                    snapshot_world_size=metadata.world_size,
+                )
+        finally:
+            storage.close()
+
+    # ------------------------------------------------------------- internals
+
+    def get_manifest(self) -> Manifest:
+        """The merged manifest of all ranks (inspection API)."""
+        storage = url_to_storage_plugin(self.path)
+        try:
+            return dict(self._read_snapshot_metadata(storage).manifest)
+        finally:
+            storage.close()
+
+    def _read_snapshot_metadata(self, storage: StoragePlugin) -> SnapshotMetadata:
+        if self._metadata_cache is None:
+            io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
+            asyncio.run(storage.read(io_req))
+            self._metadata_cache = SnapshotMetadata.from_yaml(
+                io_req.buf.getvalue().decode("utf-8")
+            )
+        return self._metadata_cache
+
+    @staticmethod
+    def _collate_path(coordinator: Coordinator, path: str) -> str:
+        collated = coordinator.broadcast_object(path, src=0)
+        if collated != path:
+            logger.warning(
+                f"Rank {coordinator.get_rank()} specified a path ({path}) "
+                f"different from rank 0 ({collated}). Using rank 0's."
+            )
+        return collated
+
+
+class _BackgroundTake:
+    def __init__(self) -> None:
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+        # The metadata document rank 0 will commit — identical on every
+        # rank (deterministic YAML of the all-gathered manifest), so any
+        # rank can recognize *this* take's commit vs a stale one.
+        self.expected_metadata_yaml: Optional[str] = None
+
+    def start(self, fn: Callable[[], None]) -> None:
+        def _run() -> None:
+            try:
+                fn()
+            except BaseException as e:  # surfaced via PendingSnapshot.wait
+                self.error = e
+
+        self.thread = threading.Thread(target=_run, name="tpusnapshot-take")
+        self.thread.start()
+
+
+class PendingSnapshot:
+    """Handle for an in-flight :meth:`Snapshot.async_take`."""
+
+    def __init__(
+        self,
+        path: str,
+        coord: Optional[Coordinator],
+        background: _BackgroundTake,
+        storage: StoragePlugin,
+    ) -> None:
+        self.path = path
+        self._coord = coord
+        self._background = background
+        self._storage = storage
+        self._result: Optional[Snapshot] = None
+
+    def done(self) -> bool:
+        thread = self._background.thread
+        return thread is not None and not thread.is_alive()
+
+    def wait(self, timeout_s: float = 1800.0) -> Snapshot:
+        """Block until the snapshot is globally committed. Idempotent.
+
+        Joining the local drain thread only proves *this* rank's writes
+        finished; the snapshot exists once rank 0 commits the metadata, so
+        non-zero ranks additionally poll storage for it.
+        """
+        if self._result is not None:
+            return self._result
+        thread = self._background.thread
+        if thread is not None:
+            thread.join()
+        try:
+            if self._background.error is None:
+                asyncio.run(
+                    _wait_for_metadata(
+                        self._storage,
+                        expected_yaml=self._background.expected_metadata_yaml,
+                        timeout_s=timeout_s,
+                    )
+                )
+        finally:
+            self._storage.close()
+        if self._background.error is not None:
+            raise self._background.error
+        self._result = Snapshot(path=self.path, coord=self._coord)
+        return self._result
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _pop_rng_state(app_state: Dict[str, Stateful]) -> Tuple[str, Optional[RNGState]]:
+    """Extract the (at most one) RNGState (reference snapshot.py:486-505)."""
+    rng_items = [
+        (key, stateful)
+        for key, stateful in app_state.items()
+        if isinstance(stateful, RNGState)
+    ]
+    if len(rng_items) > 1:
+        raise RuntimeError(
+            f"An app_state can have at most one RNGState; got {len(rng_items)}."
+        )
+    if not rng_items:
+        return "", None
+    key, stateful = rng_items[0]
+    del app_state[key]
+    return key, stateful
+
+
+def _gather_keys(coordinator: Coordinator, keys: List[str]) -> List[str]:
+    """Sorted union of every process's app-state keys (snapshot.py:477-484)."""
+    gathered = coordinator.all_gather_object(keys)
+    out: Set[str] = set()
+    for k in gathered:
+        out.update(k)
+    return sorted(out)
+
+
+def _negotiate_replicated_paths(
+    coordinator: Coordinator,
+    flattened: Dict[str, Any],
+    replicated_globs: List[str],
+) -> List[str]:
+    """Glob-match logical paths; intersect across ranks.
+
+    A path is treated as replicated only if *every* rank matched it
+    (rank-divergent globs degrade to the intersection — reference
+    snapshot.py:313-359, tests/test_replication_glob.py:103-112).
+    Partitioned arrays are excluded: the sharded category wins.
+
+    The gather runs whenever world_size > 1 — even with empty globs or an
+    absent stateful — so every rank issues the identical collective
+    sequence regardless of divergent arguments or key sets.
+    """
+    matched = set()
+    for path in flattened.keys():
+        for glob in replicated_globs:
+            if fnmatch.fnmatch(path, glob):
+                matched.add(path)
+                break
+    if coordinator.get_world_size() == 1:
+        return sorted(matched)
+    all_matched = coordinator.all_gather_object(sorted(matched))
+    inter = set(all_matched[0])
+    for m in all_matched[1:]:
+        inter &= set(m)
+    return sorted(inter)
+
+
+def _save_stateful(
+    key: str,
+    state_dict: Optional[Dict[str, Any]],
+    coordinator: Coordinator,
+    rank: int,
+    replicated_globs: List[str],
+    manifest_out: Manifest,
+    write_reqs_out: List[WriteReq],
+) -> None:
+    # A rank without this stateful still participates in the negotiation
+    # collective below (with an empty path set) so coordinator operation
+    # sequences stay aligned across ranks.
+    if state_dict is None:
+        container_manifest: Manifest = {}
+        flattened: Dict[str, Any] = {}
+    else:
+        container_manifest, flattened = flatten(state_dict, prefix=key)
+    replicated_paths = set(
+        _negotiate_replicated_paths(coordinator, flattened, replicated_globs)
+    )
+    world_size = coordinator.get_world_size()
+
+    manifest_out.update(container_manifest)
+    # Round-robin ownership stripes replicated writes across processes
+    # (reference snapshot.py:353-358). The stripe index is computed over
+    # the sorted *replicated* path set only — it is rank-identical by
+    # construction (intersection), whereas each rank's full flattened key
+    # list may diverge.
+    replicated_stripe = {
+        path: i for i, path in enumerate(sorted(replicated_paths))
+    }
+    for logical_path, value in sorted(flattened.items()):
+        replicated = logical_path in replicated_paths
+        entry, write_reqs = prepare_write(
+            obj=value, logical_path=logical_path, rank=rank, replicated=replicated
+        )
+        if isinstance(entry, ShardedArrayEntry):
+            replicated = False
+        manifest_out[logical_path] = entry
+        if replicated and replicated_stripe[logical_path] % world_size != rank:
+            continue  # another process owns this replicated write
+        write_reqs_out.extend(write_reqs)
+
+
+_COMPLETION_TIMEOUT_S = 1800.0
+
+
+def _is_not_found_error(exc: BaseException) -> bool:
+    """Whether a storage read failure means "object does not exist (yet)".
+
+    fs raises FileNotFoundError, the memory plugin KeyError; cloud client
+    not-found exception classes carry NotFound/NoSuchKey/404 in their
+    name/args. Anything else (auth, network teardown, closed client) is a
+    real error and must propagate instead of being polled into a timeout.
+    """
+    if isinstance(exc, (FileNotFoundError, KeyError)):
+        return True
+    name = type(exc).__name__
+    if "NotFound" in name or "NoSuchKey" in name:
+        return True
+    text = str(exc)
+    return "404" in text or "NoSuchKey" in text or "Not Found" in text
+
+
+async def _wait_for_completion_markers(
+    storage: StoragePlugin,
+    world_size: int,
+    nonce: str,
+    timeout_s: float = _COMPLETION_TIMEOUT_S,
+) -> None:
+    """Poll storage until every rank's write-completion marker exists."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    for r in range(world_size):
+        path = f".completed/{nonce}/{r}"
+        delay = 0.02
+        while True:
+            try:
+                await storage.read(IOReq(path=path))
+                break
+            except Exception as e:
+                if not _is_not_found_error(e):
+                    raise
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"Timed out waiting for rank {r}'s snapshot writes "
+                        f"to complete (marker {path} absent)."
+                    )
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+
+async def _wait_for_metadata(
+    storage: StoragePlugin,
+    expected_yaml: Optional[str],
+    timeout_s: float = _COMPLETION_TIMEOUT_S,
+) -> None:
+    """Poll storage until *this take's* metadata commit is observable.
+
+    Matching on content (not existence) prevents a previous take's stale
+    metadata at the same path from satisfying the wait."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    delay = 0.02
+    while True:
+        try:
+            io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
+            await storage.read(io_req)
+            content = io_req.buf.getvalue().decode("utf-8")
+            if expected_yaml is None or content == expected_yaml:
+                return
+        except Exception as e:
+            if not _is_not_found_error(e):
+                raise
+        if _time.monotonic() > deadline:
+            raise TimeoutError(
+                "Timed out waiting for the snapshot metadata commit "
+                f"({SNAPSHOT_METADATA_FNAME} absent or stale)."
+            )
+        await asyncio.sleep(delay)
+        delay = min(delay * 2, 1.0)
+
+
+def _prestage_write_reqs(write_reqs: List[WriteReq], budget: int) -> None:
+    """Eagerly stage every buffer to host (async take's consistent cut).
+
+    Concurrency is bounded by the staging thread pool; total retained host
+    memory necessarily equals the per-process checkpoint size (every
+    buffer must exist on host before control returns to training)."""
+    total = sum(wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs)
+    if total > budget:
+        logger.warning(
+            f"async_take will retain ~{total // (1 << 20)} MB of staged host "
+            f"buffers, exceeding the per-process memory budget "
+            f"({budget // (1 << 20)} MB). If this host is RAM-constrained, "
+            f"use Snapshot.take (bounded pipeline) instead."
+        )
+
+    async def _stage_all() -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .scheduler import _MAX_STAGING_THREADS
+
+        with ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS) as executor:
+            bufs = await asyncio.gather(
+                *(wr.buffer_stager.stage_buffer(executor) for wr in write_reqs)
+            )
+        for wr, buf in zip(write_reqs, bufs):
+            wr.buffer_stager = _PreStagedStager(buf)
+
+    asyncio.run(_stage_all())
+
+
+class _PreStagedStager:
+    def __init__(self, buf: Any) -> None:
+        self._buf = buf
+
+    async def stage_buffer(self, executor: Any = None) -> Any:
+        return self._buf
+
+    def get_staging_cost_bytes(self) -> int:
+        # The buffer is already retained in host memory; dispatching its
+        # write frees nothing, so charging its size would only throttle
+        # the drain (concurrency stays bounded by the IO cap).
+        return 0
+
+
+def _load_stateful(
+    key: str,
+    stateful: Stateful,
+    available: Manifest,
+    storage: StoragePlugin,
+    budget: int,
+    rank: int,
+    world_size: int,
+    snapshot_world_size: int,
+) -> None:
+    # In-place restore strategy (reference snapshot.py:374-381): the
+    # template state dict supplies dtypes/shapes/shardings so restored
+    # arrays land directly on the right devices with the right layout.
+    template_sd = stateful.state_dict()
+    container_manifest, flattened = flatten(template_sd, prefix=key)
+
+    read_reqs: List[ReadReq] = []
+    finalizers: List[Callable[[], None]] = []
+    for logical_path, template in flattened.items():
+        if logical_path not in available:
+            raise RuntimeError(
+                f'Unable to find an entry for "{logical_path}" for rank '
+                f"{rank}. The snapshot was taken with world size "
+                f"{snapshot_world_size}; the restoring world size is "
+                f"{world_size}. Snapshots are only elastic (restorable "
+                f"with a different world size) if all values are either "
+                f"sharded jax.Arrays or marked replicated at save time "
+                f"(per-rank values bind to their saving process). "
+                f"Reference semantics: torchsnapshot snapshot.py:388-406."
+            )
+        entry = available[logical_path]
+
+        def _callback(value: Any, p: str = logical_path) -> None:
+            flattened[p] = value
+
+        reqs, fins = prepare_read(entry=entry, template=template, callback=_callback)
+        read_reqs.extend(reqs)
+        finalizers.extend(fins)
+
+    asyncio.run(execute_read_reqs(read_reqs, storage, budget, rank))
+    for finalize in finalizers:
+        finalize()
+
+    # Prefer the snapshot's container entries for inflation so saved
+    # structure (e.g. dict key sets) round-trips; fall back to the
+    # template's for paths the snapshot lacks.
+    snapshot_containers = {
+        path: entry
+        for path, entry in available.items()
+        if isinstance(entry, (ListEntry, DictEntry))
+        and (path == key or path.startswith(key + "/"))
+    }
+    inflate_manifest = dict(container_manifest)
+    inflate_manifest.update(snapshot_containers)
+    new_state_dict = inflate(inflate_manifest, flattened, prefix=key)
+    stateful.load_state_dict(new_state_dict)
+
+
+def _gather_manifest(
+    coordinator: Coordinator,
+    local_manifest: Manifest,
+    take_id: Optional[str] = None,
+) -> SnapshotMetadata:
+    """All-gather per-process manifests into the global rank-prefixed view.
+
+    Replicated entries are mirrored into every rank's namespace so any
+    rank can resolve them after an elastic restore (reference
+    snapshot.py:507-527).
+    """
+    world_size = coordinator.get_world_size()
+    all_manifests = coordinator.all_gather_object(local_manifest)
+    global_manifest: Manifest = {}
+    replicated_entries: Dict[str, Entry] = {}
+    for owner_rank, m in enumerate(all_manifests):
+        for logical_path, entry in m.items():
+            global_manifest[f"{owner_rank}/{logical_path}"] = entry
+            if is_replicated(entry):
+                replicated_entries[logical_path] = entry
+    for logical_path, entry in replicated_entries.items():
+        for r in range(world_size):
+            global_manifest.setdefault(f"{r}/{logical_path}", entry)
+    return SnapshotMetadata(
+        version=__version__,
+        world_size=world_size,
+        manifest=global_manifest,
+        take_id=take_id,
+    )
+
+
+async def _awrite_snapshot_metadata(
+    storage: StoragePlugin, metadata: SnapshotMetadata
+) -> None:
+    io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
+    io_req.buf.write(metadata.to_yaml().encode("utf-8"))
+    await storage.write(io_req)
+
+
+def _write_snapshot_metadata(storage: StoragePlugin, metadata: SnapshotMetadata) -> None:
+    asyncio.run(_awrite_snapshot_metadata(storage, metadata))

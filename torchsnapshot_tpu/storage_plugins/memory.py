@@ -1,0 +1,39 @@
+"""In-memory storage plugin (beyond reference parity).
+
+Used for unit tests and as a staging target for async snapshots; also a
+handy model of an object store (flat key → bytes, ranged reads).
+"""
+
+import asyncio
+from typing import Dict, Optional
+
+from ..io_types import IOReq, StoragePlugin
+
+
+class MemoryStoragePlugin(StoragePlugin):
+    def __init__(self, store: Optional[Dict[str, bytes]] = None) -> None:
+        # A shared dict may be passed in so multiple plugin instances
+        # (e.g. simulated ranks) see one "bucket".
+        self.store: Dict[str, bytes] = store if store is not None else {}
+        self._lock = asyncio.Lock()
+
+    async def write(self, io_req: IOReq) -> None:
+        payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
+        async with self._lock:
+            self.store[io_req.path] = bytes(payload)
+
+    async def read(self, io_req: IOReq) -> None:
+        async with self._lock:
+            data = self.store[io_req.path]
+        if io_req.byte_range is not None:
+            start, end = io_req.byte_range
+            data = data[start:end]
+        io_req.buf.write(data)
+        io_req.buf.seek(0)
+
+    async def delete(self, path: str) -> None:
+        async with self._lock:
+            del self.store[path]
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,1 @@
+from .tree import to_state_dict  # noqa: F401
