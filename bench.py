@@ -1,0 +1,116 @@
+"""Snapshot throughput benchmark.
+
+TPU-native analog of the reference DDP benchmark
+(reference benchmarks/ddp/main.py:38-70): a synthetic model of N large
+parameters is snapshotted to local storage and timed. The reference's
+single-accelerator number is 0.44 GB/s (Snapshot.take, 1 GPU of a
+p4d.24xlarge against FSx Lustre — BASELINE.md); `vs_baseline` is measured
+GB/s over that.
+
+Prints exactly ONE JSON line:
+  {"metric": "snapshot_take_GBps", "value": N, "unit": "GB/s", "vs_baseline": N/0.44}
+
+Env knobs:
+  TPUSNAPSHOT_BENCH_BYTES   total parameter bytes (default 2 GiB)
+  TPUSNAPSHOT_BENCH_DIR     target directory (default: a fresh tmpdir)
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchsnapshot_tpu import Snapshot  # noqa: E402
+from torchsnapshot_tpu.models.ddp_synthetic import SyntheticModel  # noqa: E402
+
+_REFERENCE_SINGLE_ACCEL_GBPS = 0.44
+
+
+def main() -> None:
+    total_bytes = int(os.environ.get("TPUSNAPSHOT_BENCH_BYTES", 2 * 1024**3))
+    param_bytes = min(100 * 1024 * 1024, total_bytes)
+    n_params = max(1, total_bytes // param_bytes)
+
+    model = SyntheticModel(
+        n_params=n_params, param_bytes=param_bytes, dtype=jnp.float32
+    )
+    jax.block_until_ready(list(model.params.values()))
+    nbytes = model.total_bytes()
+
+    bench_dir = os.environ.get("TPUSNAPSHOT_BENCH_DIR")
+    own_dir = bench_dir is None
+    if own_dir:
+        bench_dir = tempfile.mkdtemp(prefix="tpusnapshot-bench-")
+
+    app_state = {"model": model}
+    try:
+        # Warm-up on a small state to exclude one-time costs (imports,
+        # thread pools, first D2H) from the measured run.
+        warm = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        Snapshot.take(f"{bench_dir}/warmup", {"model": warm})
+
+        # Flush dirty pages so the measured run isn't throttled by a
+        # previous run's writeback (reproducibility; the measured quantity
+        # is the wall-clock training is blocked, as in the reference
+        # benchmark which also does not fsync).
+        try:
+            os.sync()
+        except Exception:
+            pass
+
+        begin = time.monotonic()
+        Snapshot.take(f"{bench_dir}/snap", app_state)
+        elapsed = time.monotonic() - begin
+
+        gbps = nbytes / (1024**3) / elapsed
+
+        # Secondary numbers for humans (stderr; driver parses stdout only).
+        restore_begin = time.monotonic()
+        target = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        target.params = {
+            k: jnp.zeros_like(v) for k, v in model.params.items()
+        }
+        Snapshot(f"{bench_dir}/snap").restore({"model": target})
+        restore_elapsed = time.monotonic() - restore_begin
+
+        async_begin = time.monotonic()
+        pending = Snapshot.async_take(f"{bench_dir}/snap-async", app_state)
+        async_stall = time.monotonic() - async_begin
+        pending.wait()
+
+        print(
+            f"[bench] {nbytes / 1024**3:.2f} GiB, take {elapsed:.2f}s "
+            f"({gbps:.2f} GB/s), restore {restore_elapsed:.2f}s "
+            f"({nbytes / 1024**3 / restore_elapsed:.2f} GB/s), "
+            f"async stall {async_stall:.3f}s "
+            f"({100 * async_stall / (elapsed + 1e-9):.1f}% of sync take)",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "snapshot_take_GBps",
+                    "value": round(gbps, 3),
+                    "unit": "GB/s",
+                    "vs_baseline": round(gbps / _REFERENCE_SINGLE_ACCEL_GBPS, 2),
+                }
+            )
+        )
+    finally:
+        if own_dir:
+            shutil.rmtree(bench_dir, ignore_errors=True)
+        else:
+            shutil.rmtree(f"{bench_dir}/snap", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/snap-async", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/warmup", ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ import os
 
 import pytest
 
-from torchsnapshot_tpu.io_types import IOReq
+from torchsnapshot_tpu.io_types import IOReq, io_payload
 from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
 from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
 from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
@@ -17,7 +17,7 @@ def _roundtrip(plugin, path, payload, byte_range=None):
         await plugin.write(IOReq(path=path, data=payload))
         io_req = IOReq(path=path, byte_range=byte_range)
         await plugin.read(io_req)
-        return io_req.buf.getvalue()
+        return bytes(io_payload(io_req))
 
     return asyncio.run(_run())
 
@@ -46,7 +46,7 @@ def test_fs_bytesio_write_path(tmp_path):
         await plugin.write(io_req)
         out = IOReq(path="x")
         await plugin.read(out)
-        return out.buf.getvalue()
+        return bytes(io_payload(out))
 
     assert asyncio.run(_run()) == b"hello"
 
@@ -76,7 +76,7 @@ def test_memory_shared_store():
     asyncio.run(a.write(IOReq(path="k", data=b"v")))
     io_req = IOReq(path="k")
     asyncio.run(b.read(io_req))
-    assert io_req.buf.getvalue() == b"v"
+    assert bytes(io_payload(io_req)) == b"v"
 
 
 def test_url_dispatch(tmp_path):

@@ -221,7 +221,25 @@ class _ChunkCopyConsumer(BufferConsumer):
         def _copy() -> None:
             view = np.frombuffer(buf, dtype=self._dtype).reshape(self._view_shape)
             for region, region_slices, view_slices in self._copies:
-                region.buffer[region_slices] = view[view_slices]
+                if (
+                    len(self._copies) == 1
+                    and view.shape == region.buffer.shape
+                    and all(
+                        sl.start == 0 and sl.stop == dim
+                        for sl, dim in zip(region_slices, region.buffer.shape)
+                    )
+                    and all(
+                        sl.start == 0 and sl.stop == dim
+                        for sl, dim in zip(view_slices, view.shape)
+                    )
+                ):
+                    # The chunk exactly covers this region: adopt the
+                    # zero-copy view instead of memcpy-ing into the
+                    # preallocated buffer (np.frombuffer views are
+                    # read-only, which device_put accepts).
+                    region.buffer = view
+                else:
+                    region.buffer[region_slices] = view[view_slices]
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -350,10 +368,16 @@ class ArrayRestorePlan:
 
     def finalize(self) -> None:
         if self._template_is_jax:
-            arrays = []
+            # One batched device_put for all shards: the runtime issues the
+            # host→device transfers in parallel (a serial per-shard loop is
+            # memcpy/PCIe-latency bound).
+            buffers = []
+            devices = []
             for region in self._regions:
                 for device in region.devices:
-                    arrays.append(jax.device_put(region.buffer, device))
+                    buffers.append(region.buffer)
+                    devices.append(device)
+            arrays = jax.device_put(buffers, devices)
             out = jax.make_array_from_single_device_arrays(
                 tuple(self._shape), self._sharding, arrays
             )
@@ -362,6 +386,11 @@ class ArrayRestorePlan:
             self._callback(out)
         else:
             out = self._regions[0].buffer
+            if not out.flags.writeable:
+                # Adopted zero-copy payload views are read-only; host
+                # restores hand back writable arrays (apps mutate restored
+                # numpy state in place).
+                out = out.copy()
             if self._prng_impl is not None:
                 out = jax.random.wrap_key_data(out, impl=self._prng_impl)
             self._callback(out)

@@ -64,12 +64,28 @@ class IOReq:
     path: str
     buf: io.BytesIO = field(default_factory=io.BytesIO)
     byte_range: Optional[tuple] = None
-    # Write-path payload. When set, plugins write `data` directly (zero-copy
-    # from the staged host buffer) instead of draining `buf`.
+    # Zero-copy payload. Writes: when set, plugins write `data` directly
+    # instead of draining `buf`. Reads: plugins that can, return the
+    # payload here instead of memcpy-ing it into `buf`.
     data: Optional[BufferType] = None
 
 
+def io_payload(io_req: "IOReq") -> BufferType:
+    """The payload of a completed IOReq, whichever field carries it."""
+    if io_req.data is not None:
+        return io_req.data
+    return io_req.buf.getbuffer()
+
+
 class StoragePlugin(abc.ABC):
+    # How many concurrent IO ops this backend profits from, read by the
+    # scheduler as its per-pipeline concurrency caps. Object stores
+    # (GCS/S3) want many parallel streams both ways; a local disk degrades
+    # under parallel *writeback* (the fs plugin lowers the write cap) while
+    # parallel reads still help (page cache / SSD queue depth).
+    max_write_concurrency: int = 16
+    max_read_concurrency: int = 16
+
     @abc.abstractmethod
     async def write(self, io_req: IOReq) -> None:
         ...

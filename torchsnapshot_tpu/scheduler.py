@@ -34,14 +34,13 @@ from typing import Dict, List, Optional, Tuple
 
 import psutil
 
-from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq
+from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
 
 logger = logging.getLogger(__name__)
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER: float = 0.8
 _MAX_STAGING_THREADS: int = 16
-_MAX_IO_CONCURRENCY: int = 16
 
 _MEMORY_BUDGET_ENV_VAR = "TPUSNAPSHOT_PER_RANK_MEMORY_BUDGET_BYTES"
 
@@ -89,6 +88,7 @@ async def execute_write_reqs(
     io_tasks: Dict[asyncio.Task, int] = {}
     budget = memory_budget_bytes
     bytes_written = 0
+    max_io = storage.max_write_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
     try:
         while pending or staged or staging or io_tasks:
@@ -106,8 +106,8 @@ async def execute_write_reqs(
                     staging[task] = (wr, cost)
                 else:
                     break
-            # Dispatch storage writes up to the concurrency cap.
-            while staged and len(io_tasks) < _MAX_IO_CONCURRENCY:
+            # Dispatch storage writes up to the backend's concurrency cap.
+            while staged and len(io_tasks) < max_io:
                 wr, buf = staged.popleft()
                 io_req = IOReq(path=wr.path, data=buf)
                 task = asyncio.ensure_future(storage.write(io_req))
@@ -154,10 +154,11 @@ async def execute_read_reqs(
     consuming: Dict[asyncio.Task, int] = {}
     budget = memory_budget_bytes
     bytes_read = 0
+    max_io = storage.max_read_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
     try:
         while pending or reading or consuming:
-            while pending and len(reading) < _MAX_IO_CONCURRENCY:
+            while pending and len(reading) < max_io:
                 cost = pending[0].buffer_consumer.get_consuming_cost_bytes()
                 nothing_in_flight = not (reading or consuming)
                 if budget >= cost or nothing_in_flight:
@@ -183,8 +184,7 @@ async def execute_read_reqs(
             for task in done:
                 if task in reading:
                     rr, cost = reading.pop(task)
-                    io_req = task.result()
-                    buf = io_req.buf.getvalue()
+                    buf = io_payload(task.result())
                     bytes_read += len(buf)
                     consume_task = asyncio.ensure_future(
                         rr.buffer_consumer.consume_buffer(buf, executor)

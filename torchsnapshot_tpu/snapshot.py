@@ -43,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from .coord import Coordinator, get_coordinator
 from .flatten import flatten, inflate
 from .io_preparer import prepare_read, prepare_write
-from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq
+from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
 from .manifest import (
     DictEntry,
     Entry,
@@ -341,7 +341,7 @@ class Snapshot:
             io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
             asyncio.run(storage.read(io_req))
             self._metadata_cache = SnapshotMetadata.from_yaml(
-                io_req.buf.getvalue().decode("utf-8")
+                bytes(io_payload(io_req)).decode("utf-8")
             )
         return self._metadata_cache
 
@@ -596,7 +596,7 @@ async def _wait_for_metadata(
         try:
             io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
             await storage.read(io_req)
-            content = io_req.buf.getvalue().decode("utf-8")
+            content = bytes(io_payload(io_req)).decode("utf-8")
             if expected_yaml is None or content == expected_yaml:
                 return
         except Exception as e:

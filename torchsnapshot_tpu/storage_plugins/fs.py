@@ -16,6 +16,12 @@ from ..io_types import IOReq, StoragePlugin
 
 
 class FSStoragePlugin(StoragePlugin):
+    # Local disks lose throughput to writeback contention under parallel
+    # write streams (measured ~2.5x slower at 4+ writers on cloud-VM
+    # disks); two keeps the device busy across file boundaries without
+    # thrashing. Reads keep the default fan-out (queue depth helps).
+    max_write_concurrency = 2
+
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
@@ -43,10 +49,13 @@ class FSStoragePlugin(StoragePlugin):
             if io_req.byte_range is not None:
                 start, end = io_req.byte_range
                 f.seek(start)
-                io_req.buf.write(f.read(end - start))
+                payload = f.read(end - start)
             else:
-                io_req.buf.write(f.read())
-        io_req.buf.seek(0)
+                payload = f.read()
+        # Return via `data`: zero-copy for consumers. Callers that want the
+        # BytesIO interface read io_req.data themselves (wrapping here
+        # would memcpy every payload).
+        io_req.data = payload
 
     async def write(self, io_req: IOReq) -> None:
         loop = asyncio.get_running_loop()

@@ -54,8 +54,7 @@ class GCSStoragePlugin(StoragePlugin):
             data = blob.download_as_bytes(start=start, end=end - 1)
         else:
             data = blob.download_as_bytes()
-        io_req.buf.write(data)
-        io_req.buf.seek(0)
+        io_req.data = data
 
     async def write(self, io_req: IOReq) -> None:
         loop = asyncio.get_running_loop()

@@ -28,8 +28,7 @@ class MemoryStoragePlugin(StoragePlugin):
         if io_req.byte_range is not None:
             start, end = io_req.byte_range
             data = data[start:end]
-        io_req.buf.write(data)
-        io_req.buf.seek(0)
+        io_req.data = data
 
     async def delete(self, path: str) -> None:
         async with self._lock:

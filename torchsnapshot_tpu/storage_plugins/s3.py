@@ -74,7 +74,7 @@ class S3StoragePlugin(StoragePlugin):
                     kwargs["Range"] = range_hdr
                 response = await client.get_object(**kwargs)
                 async with response["Body"] as stream:
-                    io_req.buf.write(await stream.read())
+                    io_req.data = await stream.read()
         else:
             loop = asyncio.get_running_loop()
 
@@ -84,8 +84,7 @@ class S3StoragePlugin(StoragePlugin):
                     kwargs["Range"] = range_hdr
                 return self._client.get_object(**kwargs)["Body"].read()
 
-            io_req.buf.write(await loop.run_in_executor(self._executor, _get))
-        io_req.buf.seek(0)
+            io_req.data = await loop.run_in_executor(self._executor, _get)
 
     async def delete(self, path: str) -> None:
         if self._mode == "aio":
