@@ -1,0 +1,144 @@
+"""Row-sharded embedding tables: the expert/embedding-parallel workload.
+
+TPU-native analog of reference examples/torchrec_example.py:1-199, whose
+flagship is a torchrec DLRM with row-wise sharded EmbeddingBagCollection
+plus a fused optimizer. Here: several large embedding tables row-sharded
+over the device mesh (``P("ep", None)``), momentum optimizer state sharded
+identically, trained a few steps, snapshotted, and restored **onto a
+different mesh shape** (elastic) with bit-exact verification.
+
+Run:  python examples/embedding_example.py [--work-dir DIR]
+(Uses all local devices; under JAX_PLATFORMS=cpu with
+--xla_force_host_platform_device_count=8 this exercises an 8-way mesh.)
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.parallel.mesh import make_mesh
+
+TABLE_SPECS = {  # name -> (rows, dim)
+    "user_id": (1 << 14, 64),
+    "item_id": (1 << 15, 64),
+    "category": (1 << 10, 32),
+}
+
+
+class EmbeddingCollection:
+    """Row-sharded tables + momentum state; a Stateful."""
+
+    def __init__(self, mesh: Mesh, seed: int = 0):
+        self.mesh = mesh
+        keys = jax.random.split(jax.random.key(seed), len(TABLE_SPECS))
+        sharding = NamedSharding(mesh, P("ep", None))
+        self.tables = {
+            name: jax.device_put(
+                jax.random.normal(k, shape, dtype=jnp.float32) * 0.01, sharding
+            )
+            for k, (name, shape) in zip(keys, TABLE_SPECS.items())
+        }
+        self.momentum = {
+            name: jax.device_put(jnp.zeros(shape, dtype=jnp.float32), sharding)
+            for name, shape in TABLE_SPECS.items()
+        }
+
+    def state_dict(self):
+        return {"tables": self.tables, "momentum": self.momentum}
+
+    def load_state_dict(self, sd):
+        self.tables = sd["tables"]
+        self.momentum = sd["momentum"]
+
+
+def make_train_step(mesh: Mesh):
+    @jax.jit
+    def step(tables, momentum, indices, grads_seed):
+        # A toy "training" update: gather rows, compute a fake gradient,
+        # apply momentum SGD scattered back — enough to make table and
+        # momentum state diverge meaningfully per step.
+        new_tables, new_momentum = {}, {}
+        for name, table in tables.items():
+            idx = indices[name]
+            g = jax.random.normal(
+                jax.random.fold_in(grads_seed, hash(name) % (1 << 30)),
+                (idx.shape[0], table.shape[1]),
+            )
+            m = momentum[name].at[idx].mul(0.9)
+            m = m.at[idx].add(0.1 * g)
+            new_momentum[name] = m
+            new_tables[name] = table.at[idx].add(-0.05 * m[idx])
+        return new_tables, new_momentum
+
+    return step
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--work-dir", default=None)
+    args = parser.parse_args()
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="tpusnapshot-emb-")
+
+    n = len(jax.devices())
+    mesh = make_mesh({"ep": n})
+    emb = EmbeddingCollection(mesh, seed=0)
+    progress = StateDict(step=0)
+    step_fn = make_train_step(mesh)
+
+    rng = np.random.RandomState(0)
+    for i in range(3):
+        indices = {
+            name: jnp.asarray(rng.randint(0, shape[0], size=128))
+            for name, shape in TABLE_SPECS.items()
+        }
+        emb.tables, emb.momentum = step_fn(
+            emb.tables, emb.momentum, indices, jax.random.key(i)
+        )
+        progress["step"] += 1
+
+    snap_path = f"{work_dir}/snap"
+    snap = Snapshot.take(snap_path, {"emb": emb, "progress": progress})
+    print(f"snapshotted {sum(t.size for t in emb.tables.values()):,} elements "
+          f"of row-sharded embeddings -> {snap_path}")
+
+    # Elastic restore: half the devices.
+    half_mesh = make_mesh({"ep": max(1, n // 2)})
+    emb2 = EmbeddingCollection(half_mesh, seed=99)
+    progress2 = StateDict(step=-1)
+    snap.restore({"emb": emb2, "progress": progress2})
+
+    assert progress2["step"] == 3
+    for name in TABLE_SPECS:
+        np.testing.assert_array_equal(
+            np.asarray(emb2.tables[name]), np.asarray(emb.tables[name])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(emb2.momentum[name]), np.asarray(emb.momentum[name])
+        )
+        assert emb2.tables[name].sharding.mesh.shape["ep"] == max(1, n // 2)
+    print(f"OK: elastic restore {n}-way -> {max(1, n // 2)}-way row sharding, "
+          f"tables + momentum bit-exact")
+
+    # Random access: fetch a single table without restoring the rest —
+    # onto a *column*-sharded (transposed) layout, exercising arbitrary
+    # resharding of the row-sharded chunks.
+    col_template = jax.device_put(
+        jnp.zeros(TABLE_SPECS["category"], dtype=jnp.float32),
+        NamedSharding(mesh, P(None, "ep")),
+    )
+    one = snap.read_object("emb/tables/category", template=col_template)
+    np.testing.assert_array_equal(
+        np.asarray(one), np.asarray(emb.tables["category"])
+    )
+    assert one.sharding.is_equivalent_to(col_template.sharding, 2)
+    print("OK: random-access read of one table, row->column resharded")
+
+
+if __name__ == "__main__":
+    main()

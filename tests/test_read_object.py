@@ -1,0 +1,84 @@
+"""Random-access read API + inspect CLI tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+@pytest.fixture
+def snap(tmp_path):
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sd = {
+        "w": jnp.arange(24.0).reshape(4, 6),
+        "sharded": jax.device_put(
+            jnp.arange(64.0).reshape(16, 4), NamedSharding(mesh, P("x", None))
+        ),
+        "obj": {1, 2, 3},  # sets are not flattenable -> ObjectEntry leaf
+        "count": 5,
+    }
+    return Snapshot.take(str(tmp_path / "snap"), {"m": _Holder(sd), "p": StateDict(e=1)})
+
+
+def test_read_dense_array(snap):
+    out = snap.read_object("m/w")
+    np.testing.assert_array_equal(out, np.arange(24.0).reshape(4, 6))
+    assert isinstance(out, np.ndarray)
+
+
+def test_read_sharded_array_to_host(snap):
+    out = snap.read_object("m/sharded")
+    np.testing.assert_array_equal(out, np.arange(64.0).reshape(16, 4))
+
+
+def test_read_with_template_resharding(snap):
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    template = jax.device_put(
+        jnp.zeros((16, 4)), NamedSharding(mesh, P(None, "x"))
+    )
+    out = snap.read_object("m/sharded", template=template)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(64.0).reshape(16, 4))
+    assert out.sharding.is_equivalent_to(template.sharding, 2)
+
+
+def test_read_object_and_primitive(snap):
+    assert snap.read_object("m/obj") == {1, 2, 3}
+    assert snap.read_object("m/count") == 5
+    assert snap.read_object("p/e") == 1
+
+
+def test_read_missing_raises_with_suggestions(snap):
+    with pytest.raises(KeyError, match="Available leaves include"):
+        snap.read_object("m/nope")
+
+
+def test_read_container_raises(snap):
+    with pytest.raises(ValueError, match="is a container"):
+        snap.read_object("m")
+
+
+def test_inspect_cli(snap, capsys):
+    from torchsnapshot_tpu.inspect import main
+
+    assert main([snap.path]) == 0
+    out = capsys.readouterr().out
+    assert "m/w" in out
+    assert "ShardedArray" in out
+    assert "entries" in out
+    assert main([snap.path, "--raw"]) == 0
+    raw = capsys.readouterr().out
+    assert "0/m/w" in raw

@@ -57,7 +57,8 @@ def get_local_world_size(coord) -> int:
 def get_process_memory_budget_bytes(coord) -> int:
     """min(0.8 × available RAM ÷ local procs, 32 GB), env-overridable.
 
-    Reference analog: scheduler.py:41-61.
+    Reference analog: scheduler.py:41-61. Runs a collective (hostname
+    all-gather) — only call from paths where every process participates.
     """
     env_val = os.environ.get(_MEMORY_BUDGET_ENV_VAR)
     if env_val is not None:
@@ -65,6 +66,19 @@ def get_process_memory_budget_bytes(coord) -> int:
         logger.info(f"Memory budget overridden by env var: {budget} bytes")
         return budget
     local_world_size = get_local_world_size(coord)
+    return _memory_budget_for_local_world(local_world_size)
+
+
+def get_local_memory_budget_bytes() -> int:
+    """Collective-free budget (assumes this is the host's only snapshot
+    process) for single-process operations like ``Snapshot.read_object``."""
+    env_val = os.environ.get(_MEMORY_BUDGET_ENV_VAR)
+    if env_val is not None:
+        return int(env_val)
+    return _memory_budget_for_local_world(1)
+
+
+def _memory_budget_for_local_world(local_world_size: int) -> int:
     available = psutil.virtual_memory().available
     budget = min(
         int(available * _AVAILABLE_MEMORY_MULTIPLIER) // local_world_size,

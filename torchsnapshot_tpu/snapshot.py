@@ -58,6 +58,7 @@ from .rng_state import RNGState
 from .scheduler import (
     execute_read_reqs,
     execute_write_reqs,
+    get_local_memory_budget_bytes,
     get_process_memory_budget_bytes,
 )
 from .stateful import AppState, Stateful
@@ -333,6 +334,63 @@ class Snapshot:
         storage = url_to_storage_plugin(self.path)
         try:
             return dict(self._read_snapshot_metadata(storage).manifest)
+        finally:
+            storage.close()
+
+    def read_object(
+        self,
+        logical_path: str,
+        template: Any = None,
+        rank: Optional[int] = None,
+    ) -> Any:
+        """Random access: fetch ONE persisted value without a full restore.
+
+        This is the library's first differentiator over monolithic
+        checkpoint files (reference README.md / snapshot.py:71-77): every
+        leaf is its own storage object, so e.g. a single weight of a 7B
+        model can be pulled out of a multi-TB snapshot in isolation.
+
+        ``logical_path`` is ``"<stateful_key>/<flattened/path>"`` as shown
+        by :meth:`get_manifest` (without the rank prefix). ``template``
+        optionally supplies the target placement (a ``jax.Array`` template
+        reshards onto its mesh; None returns host numpy / objects).
+        ``rank`` selects the owner for per-rank values (defaults to this
+        process's rank).
+
+        Collective-free by design: safe to call from one rank, an offline
+        tool, or a notebook without desynchronizing peers.
+        """
+        coordinator = get_coordinator(self._coord)
+        rank = coordinator.get_rank() if rank is None else rank
+        storage = url_to_storage_plugin(self.path)
+        try:
+            metadata = self._read_snapshot_metadata(storage)
+            available = get_available_entries(metadata.manifest, rank)
+            if logical_path not in available:
+                known = [
+                    p for p in sorted(available)
+                    if not isinstance(available[p], (ListEntry, DictEntry))
+                ]
+                preview = ", ".join(known[:10])
+                raise KeyError(
+                    f'"{logical_path}" is not in the snapshot (for rank '
+                    f"{rank}). Available leaves include: {preview}"
+                )
+            entry = available[logical_path]
+            if isinstance(entry, (ListEntry, DictEntry)):
+                raise ValueError(
+                    f'"{logical_path}" is a container; read_object fetches '
+                    f"leaves. Use get_manifest() to enumerate its children."
+                )
+            result: Dict[str, Any] = {}
+            reqs, finalizers = prepare_read(
+                entry=entry, template=template, callback=lambda v: result.update(v=v)
+            )
+            budget = get_local_memory_budget_bytes()
+            asyncio.run(execute_read_reqs(reqs, storage, budget, rank))
+            for finalize in finalizers:
+                finalize()
+            return result["v"]
         finally:
             storage.close()
 
