@@ -1,0 +1,93 @@
+"""Watch the watchmen: the equality helpers are themselves tested
+(reference analog: tests/test_test_utils.py:27-108)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchsnapshot_tpu.utils.test_utils import (
+    assert_state_dict_eq,
+    check_state_dict_eq,
+)
+
+
+def test_equal_dicts():
+    a = {"x": np.arange(4), "y": {"z": jnp.ones(3)}, "s": "str", "n": 5}
+    b = {"x": np.arange(4), "y": {"z": jnp.ones(3)}, "s": "str", "n": 5}
+    assert check_state_dict_eq(a, b)
+    assert_state_dict_eq(a, b)
+
+
+def test_value_mismatch():
+    assert not check_state_dict_eq({"x": np.arange(4)}, {"x": np.arange(1, 5)})
+
+
+def test_shape_mismatch():
+    assert not check_state_dict_eq({"x": np.zeros(3)}, {"x": np.zeros(4)})
+
+
+def test_dtype_mismatch_exact():
+    assert not check_state_dict_eq(
+        {"x": np.zeros(3, np.float32)}, {"x": np.zeros(3, np.float64)}
+    )
+
+
+def test_key_mismatch():
+    assert not check_state_dict_eq({"x": 1}, {"y": 1})
+    assert not check_state_dict_eq({"x": 1}, {"x": 1, "y": 2})
+
+
+def test_list_and_tuple():
+    assert check_state_dict_eq([1, (2, np.ones(2))], [1, (2, np.ones(2))])
+    assert not check_state_dict_eq([1, 2], [1, 2, 3])
+
+
+def test_nan_not_equal_exact():
+    assert not check_state_dict_eq(
+        {"x": np.array([np.nan])}, {"x": np.array([0.0])}
+    )
+
+
+def test_allclose_mode():
+    a = {"x": np.array([1.0])}
+    b = {"x": np.array([1.0 + 1e-9])}
+    assert not check_state_dict_eq(a, b, exact=True)
+    assert check_state_dict_eq(a, b, exact=False)
+
+
+def test_prng_key_equality():
+    a = {"k": jax.random.key(1)}
+    b = {"k": jax.random.key(1)}
+    c = {"k": jax.random.key(2)}
+    assert check_state_dict_eq(a, b)
+    assert not check_state_dict_eq(a, c)
+
+
+def test_mixed_array_and_scalar_not_equal():
+    assert not check_state_dict_eq({"x": np.array([1])}, {"x": 1})
+
+
+def test_statefuls():
+    from torchsnapshot_tpu import FnStateful, PytreeStateful
+
+    tree = {"a": np.arange(3), "b": [1, 2]}
+    ps = PytreeStateful(tree)
+    assert ps.state_dict() is tree
+    ps.load_state_dict({"a": np.zeros(3), "b": [0]})
+    assert ps.tree["b"] == [0]
+
+    import optax
+
+    opt = optax.adam(1e-3)
+    state = opt.init({"w": jnp.ones(3)})
+    converted = PytreeStateful(state, convert=True)
+    sd = converted.state_dict()
+    assert isinstance(sd, dict)
+    converted.load_state_dict(sd)
+    assert isinstance(converted.tree, tuple)  # NamedTuple structure preserved
+
+    box = {"v": 1}
+    fs = FnStateful(lambda: {"v": box["v"]}, lambda sd: box.update(v=sd["v"]))
+    assert fs.state_dict() == {"v": 1}
+    fs.load_state_dict({"v": 42})
+    assert box["v"] == 42

@@ -18,6 +18,7 @@ from .rng_state import RNGState
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
+from .utils.train_state import FnStateful, PytreeStateful
 from .version import __version__
 
 __all__ = [
@@ -25,7 +26,9 @@ __all__ = [
     "Coordinator",
     "DictStore",
     "FileStore",
+    "FnStateful",
     "NoOpCoordinator",
+    "PytreeStateful",
     "PendingSnapshot",
     "RNGState",
     "Snapshot",

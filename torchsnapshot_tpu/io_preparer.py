@@ -61,9 +61,11 @@ from .serialization import (
     ARRAY_SERIALIZER,
     OBJECT_SERIALIZER,
     bytes_to_object,
+    compute_checksum,
     dtype_to_str,
     object_to_bytes,
     str_to_dtype,
+    verify_checksum,
 )
 
 logger = logging.getLogger(__name__)
@@ -115,9 +117,11 @@ class ArrayBufferStager(BufferStager):
         data: Any,
         chunk_slices: Optional[Tuple[slice, ...]] = None,
         nbytes: Optional[int] = None,
+        entry: Optional[ArrayEntry] = None,
     ) -> None:
         self._data = data
         self._chunk_slices = chunk_slices
+        self._entry = entry  # back-patched with the payload checksum
         if nbytes is None:
             nbytes = int(np.dtype(data.dtype).itemsize * np.prod(data.shape))
         self._nbytes = nbytes
@@ -146,17 +150,25 @@ class ArrayBufferStager(BufferStager):
         # Reinterpret as raw bytes: ml_dtypes dtypes (bfloat16, float8_*)
         # don't export the buffer protocol directly, but a uint8 view does,
         # and it is zero-copy.
-        return memoryview(host.reshape(-1).view(np.uint8))
+        payload = memoryview(host.reshape(-1).view(np.uint8))
+        if self._entry is not None:
+            # Staging runs before the manifest all-gather on every path
+            # (sync: writes precede the gather; async: prestage precedes
+            # it), so the checksum lands in the persisted metadata.
+            self._entry.checksum = compute_checksum(payload)
+        return payload
 
     def get_staging_cost_bytes(self) -> int:
         return self._nbytes
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any) -> None:
+    def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
         # Objects are small (counters, RNG states, dataloader cursors);
         # pickle eagerly so the staging cost is exact.
         self._buf = object_to_bytes(obj)
+        if entry is not None:
+            entry.checksum = compute_checksum(self._buf)
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         return self._buf
@@ -170,18 +182,28 @@ class ObjectBufferConsumer(BufferConsumer):
     (reference io_preparer.py:290-304: objects cannot be restored in place).
     """
 
-    def __init__(self, callback: Callable[[Any], None], size_hint: int = 1 << 20):
+    def __init__(
+        self,
+        callback: Callable[[Any], None],
+        size_hint: int = 1 << 20,
+        checksum: Optional[str] = None,
+    ):
         self._callback = callback
         self._size_hint = size_hint
+        self._checksum = checksum
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        def _load() -> Any:
+            verify_checksum(buf, self._checksum)
+            return bytes_to_object(buf)
+
         if executor is not None:
             loop = asyncio.get_running_loop()
-            obj = await loop.run_in_executor(executor, bytes_to_object, buf)
+            obj = await loop.run_in_executor(executor, _load)
         else:
-            obj = bytes_to_object(buf)
+            obj = _load()
         self._callback(obj)
 
     def get_consuming_cost_bytes(self) -> int:
@@ -208,17 +230,20 @@ class _ChunkCopyConsumer(BufferConsumer):
         view_shape: List[int],
         dtype: np.dtype,
         copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Tuple[slice, ...]]],
+        checksum: Optional[str] = None,
     ) -> None:
         # copies: (region, region_slices, view_slices)
         self._view_shape = view_shape
         self._dtype = dtype
         self._copies = copies
+        self._checksum = checksum
         self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _copy() -> None:
+            verify_checksum(buf, self._checksum)
             view = np.frombuffer(buf, dtype=self._dtype).reshape(self._view_shape)
             for region, region_slices, view_slices in self._copies:
                 if (
@@ -263,11 +288,12 @@ class ArrayRestorePlan:
         if isinstance(entry, ShardedArrayEntry):
             dtype_name, shape = entry.dtype, list(entry.shape)
             chunks = [
-                (list(s.offsets), list(s.sizes), s.array.location) for s in entry.shards
+                (list(s.offsets), list(s.sizes), s.array.location, s.array.checksum)
+                for s in entry.shards
             ]
         elif isinstance(entry, ArrayEntry):
             dtype_name, shape = entry.dtype, list(entry.shape)
-            chunks = [([0] * len(shape), list(shape), entry.location)]
+            chunks = [([0] * len(shape), list(shape), entry.location, entry.checksum)]
         else:
             raise TypeError(f"Not an array entry: {type(entry)}")
         self._entry = entry
@@ -320,7 +346,7 @@ class ArrayRestorePlan:
     def build_read_reqs(self) -> List[ReadReq]:
         reqs: List[ReadReq] = []
         itemsize = np.dtype(self._dtype).itemsize
-        for chunk_off, chunk_sz, location in self._chunks:
+        for chunk_off, chunk_sz, location, chunk_checksum in self._chunks:
             copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Overlap]] = []
             for region in self._regions:
                 ov = compute_overlap(chunk_off, chunk_sz, region.offsets, region.sizes)
@@ -354,7 +380,8 @@ class ArrayRestorePlan:
                     )
             else:
                 # Non-contiguous overlap somewhere: read the chunk once and
-                # scatter into every overlapping region.
+                # scatter into every overlapping region. Whole-object reads
+                # can verify the stored checksum (ranged reads cannot).
                 consumer = _ChunkCopyConsumer(
                     view_shape=list(chunk_sz),
                     dtype=self._dtype,
@@ -362,6 +389,7 @@ class ArrayRestorePlan:
                         (region, region_slices, ov.chunk_slices)
                         for region, region_slices, ov in copies
                     ],
+                    checksum=chunk_checksum,
                 )
                 reqs.append(ReadReq(path=location, buffer_consumer=consumer))
         return reqs
@@ -421,7 +449,7 @@ def _prepare_dense_array_write(
     )
     if prng_impl is not None:
         entry.prng_impl = prng_impl
-    stager = ArrayBufferStager(arr)
+    stager = ArrayBufferStager(arr, entry=entry)
     return entry, [WriteReq(path=location, buffer_stager=stager)]
 
 
@@ -461,7 +489,7 @@ def _prepare_sharded_array_write(
             )
             shards.append(Shard(offsets=list(c_off), sizes=list(c_sz), array=entry))
             if whole:
-                stager = ArrayBufferStager(shard.data)
+                stager = ArrayBufferStager(shard.data, entry=entry)
             else:
                 local = tuple(
                     slice(co - o, co - o + cs) for co, cs, o in zip(c_off, c_sz, off)
@@ -470,6 +498,7 @@ def _prepare_sharded_array_write(
                     shard.data,
                     chunk_slices=local,
                     nbytes=_chunk_nbytes(c_sz, dtype.itemsize),
+                    entry=entry,
                 )
             reqs.append(WriteReq(path=location, buffer_stager=stager))
     return (
@@ -508,7 +537,7 @@ def prepare_write(
     entry = ObjectEntry(
         location=location, serializer=OBJECT_SERIALIZER, replicated=replicated
     )
-    stager = ObjectBufferStager(obj)
+    stager = ObjectBufferStager(obj, entry=entry)
     return entry, [WriteReq(path=location, buffer_stager=stager)]
 
 
@@ -526,7 +555,7 @@ def prepare_read(
         callback(entry.get_value())
         return [], []
     if isinstance(entry, ObjectEntry):
-        consumer = ObjectBufferConsumer(callback)
+        consumer = ObjectBufferConsumer(callback, checksum=entry.checksum)
         return [ReadReq(path=entry.location, buffer_consumer=consumer)], []
     if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
         plan = ArrayRestorePlan(entry, template, callback)

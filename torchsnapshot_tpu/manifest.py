@@ -63,6 +63,8 @@ class ArrayEntry(Entry):
     # For jax PRNG key arrays: the impl name (e.g. "threefry2x32"); the
     # payload is then the uint32 key data and `shape` is the key-data shape.
     prng_impl: Optional[str] = None
+    # Payload integrity tag ("crc32:<hex>"), set at staging time.
+    checksum: Optional[str] = None
 
     def __init__(
         self,
@@ -72,6 +74,7 @@ class ArrayEntry(Entry):
         shape: List[int],
         replicated: bool,
         prng_impl: Optional[str] = None,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -80,6 +83,7 @@ class ArrayEntry(Entry):
         self.shape = list(shape)
         self.replicated = replicated
         self.prng_impl = prng_impl
+        self.checksum = checksum
 
 
 @dataclass
@@ -116,12 +120,20 @@ class ObjectEntry(Entry):
     location: str
     serializer: str  # "pickle"
     replicated: bool
+    checksum: Optional[str] = None
 
-    def __init__(self, location: str, serializer: str, replicated: bool) -> None:
+    def __init__(
+        self,
+        location: str,
+        serializer: str,
+        replicated: bool,
+        checksum: Optional[str] = None,
+    ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.replicated = replicated
+        self.checksum = checksum
 
 
 @dataclass
@@ -343,7 +355,16 @@ def get_available_entries(manifest: Manifest, rank: int) -> Manifest:
                 prng_impl=sample.prng_impl,
             )
         elif is_replicated(sample):
-            available[local_path] = sample
+            # Prefer the entry carrying a checksum: only the stripe owner
+            # (the rank whose bytes were stored) records one.
+            available[local_path] = next(
+                (
+                    e
+                    for e in by_rank.values()
+                    if getattr(e, "checksum", None) is not None
+                ),
+                sample,
+            )
         elif isinstance(sample, (ListEntry, DictEntry)):
             # Containers are visible to every rank, but per-rank structure
             # may diverge (e.g. dict key sets differing across ranks):

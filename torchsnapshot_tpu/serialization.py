@@ -14,7 +14,8 @@ Objects (non-array leaves) use pickle protocol 4.
 
 import pickle
 import sys
-from typing import Any, List, Tuple
+import zlib
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -79,3 +80,27 @@ def bytes_to_object(buf: bytes) -> Any:
 
 def array_meta(arr: np.ndarray) -> Tuple[str, List[int]]:
     return dtype_to_str(arr.dtype), list(arr.shape)
+
+
+def compute_checksum(buf: Any) -> str:
+    """crc32 of a payload, tagged with the algorithm for evolvability.
+
+    Beyond reference parity: torchsnapshot has no integrity checking
+    (SURVEY §5 — silent storage corruption flows straight into restored
+    weights). zlib.crc32 runs >1 GB/s in C with the GIL released, so it is
+    ~free inside the staging thread pool.
+    """
+    return f"crc32:{zlib.crc32(buf) & 0xFFFFFFFF:08x}"
+
+
+def verify_checksum(buf: Any, expected: Optional[str]) -> None:
+    """Raise if ``buf`` does not match ``expected`` (no-op when expected is
+    None or the algorithm is unknown — forward compatibility)."""
+    if not expected or not expected.startswith("crc32:"):
+        return
+    actual = compute_checksum(buf)
+    if actual != expected:
+        raise RuntimeError(
+            f"Checksum mismatch: stored object is corrupt "
+            f"(expected {expected}, got {actual})."
+        )

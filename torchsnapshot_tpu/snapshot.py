@@ -584,7 +584,13 @@ def _save_stateful(
             replicated = False
         manifest_out[logical_path] = entry
         if replicated and replicated_stripe[logical_path] % world_size != rank:
-            continue  # another process owns this replicated write
+            # Another process owns this replicated write. Its payload bytes
+            # (hence checksum) are the owner's — ours may legitimately
+            # differ (e.g. pickle insertion order) and must not be
+            # advertised as the stored object's checksum.
+            if hasattr(entry, "checksum"):
+                entry.checksum = None
+            continue
         write_reqs_out.extend(write_reqs)
 
 
@@ -790,7 +796,14 @@ def _gather_manifest(
         for logical_path, entry in m.items():
             global_manifest[f"{owner_rank}/{logical_path}"] = entry
             if is_replicated(entry):
-                replicated_entries[logical_path] = entry
+                # Prefer the stripe owner's entry — only it carries the
+                # checksum of the bytes actually stored.
+                current = replicated_entries.get(logical_path)
+                if current is None or (
+                    getattr(entry, "checksum", None)
+                    and not getattr(current, "checksum", None)
+                ):
+                    replicated_entries[logical_path] = entry
     for logical_path, entry in replicated_entries.items():
         for r in range(world_size):
             global_manifest.setdefault(f"{r}/{logical_path}", entry)
