@@ -153,3 +153,67 @@ def test_checksum_yaml_round_trip(tmp_path):
         SnapshotMetadata(version="v", world_size=1, manifest={"0/p/x": e}).to_yaml()
     )
     assert restored.manifest["0/p/x"].checksum == e.checksum
+
+
+def test_strict_integrity_detects_corruption_on_reshard(tmp_path, monkeypatch):
+    """Ranged partial reads skip checksum verification by design;
+    TPUSNAPSHOT_STRICT_INTEGRITY=1 forces whole-chunk verified reads so a
+    reshard-restore still detects corruption."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot
+
+    class _Holder:
+        def __init__(self, sd):
+            self.sd = sd
+
+        def state_dict(self):
+            return self.sd
+
+        def load_state_dict(self, sd):
+            self.sd = sd
+
+    data = np.arange(64, dtype=np.float32)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("x",))
+    arr = jax.device_put(data, NamedSharding(mesh2, P("x")))
+    Snapshot.take(str(tmp_path / "snap"), {"m": _Holder({"w": arr})})
+
+    # Corrupt one stored chunk.
+    chunks = sorted((tmp_path / "snap" / "sharded").rglob("*"))
+    chunks = [c for c in chunks if c.is_file()]
+    payload = bytearray(chunks[0].read_bytes())
+    payload[8] ^= 0xFF
+    chunks[0].write_bytes(bytes(payload))
+
+    # Restore onto a finer sharding => partial (ranged) reads of each chunk.
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("x",))
+    template = jax.device_put(
+        jnp.zeros((64,), dtype=jnp.float32), NamedSharding(mesh4, P("x"))
+    )
+
+    monkeypatch.setenv("TPUSNAPSHOT_STRICT_INTEGRITY", "1")
+    target = _Holder({"w": template})
+    with pytest.raises(Exception, match="[Cc]hecksum|corrupt"):
+        Snapshot(str(tmp_path / "snap")).restore({"m": target})
+
+
+def test_object_checksum_set_at_stage_time_only():
+    """Non-owner ranks of replicated objects drop their write reqs before
+    staging; the checksum/compression must therefore be patched at stage
+    time (owners), never in the constructor."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_preparer import ObjectBufferStager
+    from torchsnapshot_tpu.manifest import ObjectEntry
+
+    entry = ObjectEntry(location="0/x", serializer="pickle", replicated=True)
+    stager = ObjectBufferStager({1, 2, 3}, entry=entry, compression="zlib")
+    assert entry.checksum is None and entry.compression is None
+    buf = asyncio.run(stager.stage_buffer())
+    assert entry.checksum is not None and entry.compression == "zlib"
+    from torchsnapshot_tpu.serialization import decompress_payload, bytes_to_object
+
+    assert bytes_to_object(decompress_payload(buf, "zlib")) == {1, 2, 3}

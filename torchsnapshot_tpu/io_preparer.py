@@ -34,6 +34,7 @@ restore (reference analog: resharding.py:135-199 + io_preparer.py:113-163).
 
 import asyncio
 import logging
+import os
 from concurrent.futures import Executor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -61,7 +62,9 @@ from .serialization import (
     ARRAY_SERIALIZER,
     OBJECT_SERIALIZER,
     bytes_to_object,
+    compress_payload,
     compute_checksum,
+    decompress_payload,
     dtype_to_str,
     object_to_bytes,
     str_to_dtype,
@@ -118,9 +121,11 @@ class ArrayBufferStager(BufferStager):
         chunk_slices: Optional[Tuple[slice, ...]] = None,
         nbytes: Optional[int] = None,
         entry: Optional[ArrayEntry] = None,
+        compression: Optional[str] = None,
     ) -> None:
         self._data = data
         self._chunk_slices = chunk_slices
+        self._compression = compression
         self._entry = entry  # back-patched with the payload checksum
         if nbytes is None:
             nbytes = int(np.dtype(data.dtype).itemsize * np.prod(data.shape))
@@ -151,6 +156,10 @@ class ArrayBufferStager(BufferStager):
         # don't export the buffer protocol directly, but a uint8 view does,
         # and it is zero-copy.
         payload = memoryview(host.reshape(-1).view(np.uint8))
+        if self._compression is not None:
+            payload = compress_payload(payload, self._compression)
+            if self._entry is not None:
+                self._entry.compression = self._compression
         if self._entry is not None:
             # Staging runs before the manifest all-gather on every path
             # (sync: writes precede the gather; async: prestage precedes
@@ -163,14 +172,33 @@ class ArrayBufferStager(BufferStager):
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
+    def __init__(
+        self,
+        obj: Any,
+        entry: Optional[ObjectEntry] = None,
+        compression: Optional[str] = None,
+    ) -> None:
         # Objects are small (counters, RNG states, dataloader cursors);
-        # pickle eagerly so the staging cost is exact.
-        self._buf = object_to_bytes(obj)
-        if entry is not None:
-            entry.checksum = compute_checksum(self._buf)
+        # pickle eagerly so the staging cost is exact. Compression and
+        # checksum are deferred to stage time: non-owner ranks of a
+        # replicated object drop their write request without staging, so
+        # they never pay those costs (their manifest entry legitimately
+        # carries checksum/compression = None; the restore path prefers
+        # the stripe owner's checksum-bearing entry).
+        self._buf: BufferType = object_to_bytes(obj)
+        self._entry = entry
+        self._compression = compression
+        self._staged = False
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        if not self._staged:
+            self._staged = True
+            if self._compression is not None:
+                self._buf = compress_payload(self._buf, self._compression)
+                if self._entry is not None:
+                    self._entry.compression = self._compression
+            if self._entry is not None:
+                self._entry.checksum = compute_checksum(self._buf)
         return self._buf
 
     def get_staging_cost_bytes(self) -> int:
@@ -187,17 +215,24 @@ class ObjectBufferConsumer(BufferConsumer):
         callback: Callable[[Any], None],
         size_hint: int = 1 << 20,
         checksum: Optional[str] = None,
+        compression: Optional[str] = None,
     ):
         self._callback = callback
         self._size_hint = size_hint
         self._checksum = checksum
+        self._compression = compression
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _load() -> Any:
             verify_checksum(buf, self._checksum)
-            return bytes_to_object(buf)
+            raw = (
+                decompress_payload(buf, self._compression)
+                if self._compression is not None
+                else buf
+            )
+            return bytes_to_object(raw)
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -231,12 +266,14 @@ class _ChunkCopyConsumer(BufferConsumer):
         dtype: np.dtype,
         copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Tuple[slice, ...]]],
         checksum: Optional[str] = None,
+        compression: Optional[str] = None,
     ) -> None:
         # copies: (region, region_slices, view_slices)
         self._view_shape = view_shape
         self._dtype = dtype
         self._copies = copies
         self._checksum = checksum
+        self._compression = compression
         self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
 
     async def consume_buffer(
@@ -244,7 +281,13 @@ class _ChunkCopyConsumer(BufferConsumer):
     ) -> None:
         def _copy() -> None:
             verify_checksum(buf, self._checksum)
-            view = np.frombuffer(buf, dtype=self._dtype).reshape(self._view_shape)
+            if self._compression is not None:
+                buf_raw = decompress_payload(buf, self._compression)
+            else:
+                buf_raw = buf
+            view = np.frombuffer(buf_raw, dtype=self._dtype).reshape(
+                self._view_shape
+            )
             for region, region_slices, view_slices in self._copies:
                 if (
                     len(self._copies) == 1
@@ -288,12 +331,26 @@ class ArrayRestorePlan:
         if isinstance(entry, ShardedArrayEntry):
             dtype_name, shape = entry.dtype, list(entry.shape)
             chunks = [
-                (list(s.offsets), list(s.sizes), s.array.location, s.array.checksum)
+                (
+                    list(s.offsets),
+                    list(s.sizes),
+                    s.array.location,
+                    s.array.checksum,
+                    s.array.compression,
+                )
                 for s in entry.shards
             ]
         elif isinstance(entry, ArrayEntry):
             dtype_name, shape = entry.dtype, list(entry.shape)
-            chunks = [([0] * len(shape), list(shape), entry.location, entry.checksum)]
+            chunks = [
+                (
+                    [0] * len(shape),
+                    list(shape),
+                    entry.location,
+                    entry.checksum,
+                    entry.compression,
+                )
+            ]
         else:
             raise TypeError(f"Not an array entry: {type(entry)}")
         self._entry = entry
@@ -346,7 +403,7 @@ class ArrayRestorePlan:
     def build_read_reqs(self) -> List[ReadReq]:
         reqs: List[ReadReq] = []
         itemsize = np.dtype(self._dtype).itemsize
-        for chunk_off, chunk_sz, location, chunk_checksum in self._chunks:
+        for chunk_off, chunk_sz, location, chunk_checksum, compression in self._chunks:
             copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Overlap]] = []
             for region in self._regions:
                 ov = compute_overlap(chunk_off, chunk_sz, region.offsets, region.sizes)
@@ -362,7 +419,18 @@ class ArrayRestorePlan:
             partial = len(copies) > 1 or (
                 ranges[0] is not None and (ranges[0][1] - ranges[0][0]) < chunk_nbytes
             )
-            if all(r is not None for r in ranges) and partial:
+            # Compressed chunks admit no ranged reads (byte offsets into the
+            # compressed stream are meaningless): always read whole. Ranged
+            # reads also cannot verify the chunk's checksum (it covers the
+            # whole stored object) — TPUSNAPSHOT_STRICT_INTEGRITY=1 trades
+            # the ranged-read bandwidth savings for full verification.
+            strict = os.environ.get("TPUSNAPSHOT_STRICT_INTEGRITY") == "1"
+            if (
+                compression is None
+                and not strict
+                and all(r is not None for r in ranges)
+                and partial
+            ):
                 # Every overlap is a contiguous byte run of the chunk: issue
                 # one ranged read per target region (parallel, and each
                 # process/device fetches only the bytes it needs).
@@ -390,6 +458,7 @@ class ArrayRestorePlan:
                         for region, region_slices, ov in copies
                     ],
                     checksum=chunk_checksum,
+                    compression=compression,
                 )
                 reqs.append(ReadReq(path=location, buffer_consumer=consumer))
         return reqs
@@ -432,7 +501,11 @@ def _chunk_nbytes(sizes: List[int], itemsize: int) -> int:
 
 
 def _prepare_dense_array_write(
-    arr: Any, logical_path: str, rank: int, replicated: bool
+    arr: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool,
+    compression: Optional[str] = None,
 ) -> Tuple[ArrayEntry, List[WriteReq]]:
     prng_impl = None
     if _is_prng_key_array(arr):
@@ -449,12 +522,12 @@ def _prepare_dense_array_write(
     )
     if prng_impl is not None:
         entry.prng_impl = prng_impl
-    stager = ArrayBufferStager(arr, entry=entry)
+    stager = ArrayBufferStager(arr, entry=entry, compression=compression)
     return entry, [WriteReq(path=location, buffer_stager=stager)]
 
 
 def _prepare_sharded_array_write(
-    arr: jax.Array, logical_path: str
+    arr: jax.Array, logical_path: str, compression: Optional[str] = None
 ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
     prng_impl = None
     if _is_prng_key_array(arr):
@@ -489,7 +562,9 @@ def _prepare_sharded_array_write(
             )
             shards.append(Shard(offsets=list(c_off), sizes=list(c_sz), array=entry))
             if whole:
-                stager = ArrayBufferStager(shard.data, entry=entry)
+                stager = ArrayBufferStager(
+                    shard.data, entry=entry, compression=compression
+                )
             else:
                 local = tuple(
                     slice(co - o, co - o + cs) for co, cs, o in zip(c_off, c_sz, off)
@@ -499,6 +574,7 @@ def _prepare_sharded_array_write(
                     chunk_slices=local,
                     nbytes=_chunk_nbytes(c_sz, dtype.itemsize),
                     entry=entry,
+                    compression=compression,
                 )
             reqs.append(WriteReq(path=location, buffer_stager=stager))
     return (
@@ -513,7 +589,11 @@ def _prepare_sharded_array_write(
 
 
 def prepare_write(
-    obj: Any, logical_path: str, rank: int, replicated: bool = False
+    obj: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool = False,
+    compression: Optional[str] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """Plan the persistence of one leaf value.
 
@@ -525,19 +605,21 @@ def prepare_write(
     # array check must run before the primitive check.
     if isinstance(obj, (np.generic, np.ndarray)):
         return _prepare_dense_array_write(
-            np.asarray(obj), logical_path, rank, replicated
+            np.asarray(obj), logical_path, rank, replicated, compression
         )
     if isinstance(obj, _PRIMITIVE_TYPES):
         return PrimitiveEntry.from_value(obj, replicated=replicated), []
     if _is_jax_array(obj) and _is_partitioned(obj):
-        return _prepare_sharded_array_write(obj, logical_path)
+        return _prepare_sharded_array_write(obj, logical_path, compression)
     if _is_jax_array(obj):
-        return _prepare_dense_array_write(obj, logical_path, rank, replicated)
+        return _prepare_dense_array_write(
+            obj, logical_path, rank, replicated, compression
+        )
     location = get_storage_path(rank, logical_path, replicated)
     entry = ObjectEntry(
         location=location, serializer=OBJECT_SERIALIZER, replicated=replicated
     )
-    stager = ObjectBufferStager(obj, entry=entry)
+    stager = ObjectBufferStager(obj, entry=entry, compression=compression)
     return entry, [WriteReq(path=location, buffer_stager=stager)]
 
 
@@ -555,7 +637,9 @@ def prepare_read(
         callback(entry.get_value())
         return [], []
     if isinstance(entry, ObjectEntry):
-        consumer = ObjectBufferConsumer(callback, checksum=entry.checksum)
+        consumer = ObjectBufferConsumer(
+            callback, checksum=entry.checksum, compression=entry.compression
+        )
         return [ReadReq(path=entry.location, buffer_consumer=consumer)], []
     if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
         plan = ArrayRestorePlan(entry, template, callback)

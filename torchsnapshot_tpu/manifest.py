@@ -65,6 +65,9 @@ class ArrayEntry(Entry):
     prng_impl: Optional[str] = None
     # Payload integrity tag ("crc32:<hex>"), set at staging time.
     checksum: Optional[str] = None
+    # Lossless compression of the stored payload ("zlib" or None). The
+    # checksum covers the stored (compressed) bytes.
+    compression: Optional[str] = None
 
     def __init__(
         self,
@@ -75,6 +78,7 @@ class ArrayEntry(Entry):
         replicated: bool,
         prng_impl: Optional[str] = None,
         checksum: Optional[str] = None,
+        compression: Optional[str] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -84,6 +88,7 @@ class ArrayEntry(Entry):
         self.replicated = replicated
         self.prng_impl = prng_impl
         self.checksum = checksum
+        self.compression = compression
 
 
 @dataclass
@@ -121,6 +126,7 @@ class ObjectEntry(Entry):
     serializer: str  # "pickle"
     replicated: bool
     checksum: Optional[str] = None
+    compression: Optional[str] = None
 
     def __init__(
         self,
@@ -128,12 +134,14 @@ class ObjectEntry(Entry):
         serializer: str,
         replicated: bool,
         checksum: Optional[str] = None,
+        compression: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.replicated = replicated
         self.checksum = checksum
+        self.compression = compression
 
 
 @dataclass

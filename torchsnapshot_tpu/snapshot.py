@@ -55,6 +55,7 @@ from .manifest import (
     is_replicated,
 )
 from .rng_state import RNGState
+from .serialization import check_compression
 from .scheduler import (
     execute_read_reqs,
     execute_write_reqs,
@@ -91,11 +92,16 @@ class Snapshot:
         app_state: AppState,
         coord: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
+        compression: Optional[str] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` to ``path``; returns a handle.
 
-        Reference analog: snapshot.py:134-224.
+        Reference analog: snapshot.py:134-224. ``compression`` ("zlib" or
+        None) losslessly compresses stored payloads (beyond parity); the
+        restore side is driven entirely by the manifest, so no flag is
+        needed on restore.
         """
+        check_compression(compression)
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
         storage = url_to_storage_plugin(path)
@@ -107,6 +113,7 @@ class Snapshot:
                 storage=storage,
                 replicated=replicated or [],
                 background=None,
+                compression=compression,
             )
         finally:
             storage.close()
@@ -119,6 +126,7 @@ class Snapshot:
         app_state: AppState,
         coord: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
+        compression: Optional[str] = None,
     ) -> "PendingSnapshot":
         """Take a snapshot with storage writes overlapped with training.
 
@@ -127,6 +135,7 @@ class Snapshot:
         metadata commit drain on a background thread. Call ``.wait()`` (or
         check ``.done()``) before depending on the snapshot.
         """
+        check_compression(compression)
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
         storage = url_to_storage_plugin(path)
@@ -139,6 +148,7 @@ class Snapshot:
                 storage=storage,
                 replicated=replicated or [],
                 background=background,
+                compression=compression,
             )
         except BaseException:
             storage.close()
@@ -156,6 +166,7 @@ class Snapshot:
         storage: StoragePlugin,
         replicated: List[str],
         background: Optional["_BackgroundTake"],
+        compression: Optional[str] = None,
     ) -> None:
         app_state = dict(app_state)
         rank = coordinator.get_rank()
@@ -185,6 +196,7 @@ class Snapshot:
                 replicated_globs=replicated,
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
+                compression=compression,
             )
 
         global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
@@ -198,6 +210,7 @@ class Snapshot:
                 replicated_globs=replicated,
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
+                compression=compression,
             )
             coordinator.barrier()
 
@@ -552,6 +565,7 @@ def _save_stateful(
     replicated_globs: List[str],
     manifest_out: Manifest,
     write_reqs_out: List[WriteReq],
+    compression: Optional[str] = None,
 ) -> None:
     # A rank without this stateful still participates in the negotiation
     # collective below (with an empty path set) so coordinator operation
@@ -578,7 +592,11 @@ def _save_stateful(
     for logical_path, value in sorted(flattened.items()):
         replicated = logical_path in replicated_paths
         entry, write_reqs = prepare_write(
-            obj=value, logical_path=logical_path, rank=rank, replicated=replicated
+            obj=value,
+            logical_path=logical_path,
+            rank=rank,
+            replicated=replicated,
+            compression=compression,
         )
         if isinstance(entry, ShardedArrayEntry):
             replicated = False

@@ -10,7 +10,7 @@ overlapping byte ranges.
 
 import asyncio
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Any, Optional
 
 from ..io_types import IOReq, StoragePlugin
 
@@ -18,20 +18,24 @@ _IO_THREADS = 8
 
 
 class GCSStoragePlugin(StoragePlugin):
-    def __init__(self, root: str) -> None:
-        try:
-            from google.cloud import storage  # type: ignore
-        except ImportError as e:  # pragma: no cover
-            raise RuntimeError(
-                "GCS support requires the google-cloud-storage package."
-            ) from e
+    def __init__(self, root: str, client: Optional[Any] = None) -> None:
+        """``client`` injects a pre-built (or fake) ``storage.Client`` —
+        the default constructs one from ambient credentials."""
         components = root.split("/", 1)
         if len(components) != 2:
             raise ValueError(
                 f'GCS root must be a "bucket/path" pair, got "{root}".'
             )
         self.bucket_name, self.root = components
-        self._client = storage.Client()
+        if client is None:
+            try:
+                from google.cloud import storage  # type: ignore
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "GCS support requires the google-cloud-storage package."
+                ) from e
+            client = storage.Client()
+        self._client = client
         self._bucket = self._client.bucket(self.bucket_name)
         self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
 

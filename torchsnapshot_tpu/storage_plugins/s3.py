@@ -9,6 +9,7 @@ installed (optional-import pattern, reference s3.py:16-22).
 
 import asyncio
 from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
 
 from ..io_types import IOReq, StoragePlugin
 
@@ -16,24 +17,31 @@ _IO_THREADS = 8
 
 
 class S3StoragePlugin(StoragePlugin):
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, client: Optional[Any] = None) -> None:
+        """``client`` injects a pre-built (or fake) sync boto3-style
+        client; the default autodetects aiobotocore, then boto3."""
         self._mode = None
-        try:
-            from aiobotocore.session import get_session  # type: ignore
-
-            self._session = get_session()
-            self._mode = "aio"
-        except ImportError:
+        if client is not None:
+            self._client = client
+            self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
+            self._mode = "sync"
+        else:
             try:
-                import boto3  # type: ignore
+                from aiobotocore.session import get_session  # type: ignore
 
-                self._client = boto3.client("s3")
-                self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
-                self._mode = "sync"
-            except ImportError as e:
-                raise RuntimeError(
-                    "S3 support requires aiobotocore or boto3."
-                ) from e
+                self._session = get_session()
+                self._mode = "aio"
+            except ImportError:
+                try:
+                    import boto3  # type: ignore
+
+                    self._client = boto3.client("s3")
+                    self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
+                    self._mode = "sync"
+                except ImportError as e:
+                    raise RuntimeError(
+                        "S3 support requires aiobotocore or boto3."
+                    ) from e
         components = root.split("/", 1)
         if len(components) != 2:
             raise ValueError(f'S3 root must be a "bucket/path" pair, got "{root}".')
