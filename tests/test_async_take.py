@@ -117,3 +117,31 @@ def test_async_take_multirank(tmp_path):
         t.start()
     for t in threads:
         t.join(timeout=120)
+
+
+@pytest.mark.parametrize("stage", ["device", "host", "auto"])
+def test_async_take_stage_modes(tmp_path, stage):
+    """All staging modes produce identical, donation-safe snapshots."""
+    arr = jnp.arange(2048, dtype=jnp.float32) * 3.0
+    sharded = {"w": arr, "b": np.full(16, 7.0, dtype=np.float32)}
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"m": _Holder(dict(sharded))}, stage=stage
+    )
+    arr.delete()  # simulate jit buffer donation
+    sharded["b"][:] = -1.0  # mutate host memory after the cut
+    snap = pending.wait()
+    target = _Holder(
+        {"w": jnp.zeros(2048), "b": np.zeros(16, dtype=np.float32)}
+    )
+    snap.restore({"m": target})
+    np.testing.assert_array_equal(
+        np.asarray(target.sd["w"]), np.arange(2048, dtype=np.float32) * 3.0
+    )
+    np.testing.assert_array_equal(target.sd["b"], np.full(16, 7.0))
+
+
+def test_async_take_invalid_stage(tmp_path):
+    with pytest.raises(ValueError, match="stage"):
+        Snapshot.async_take(
+            str(tmp_path / "snap"), {"m": _Holder({})}, stage="bogus"
+        )

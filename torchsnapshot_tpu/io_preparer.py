@@ -34,8 +34,10 @@ restore (reference analog: resharding.py:135-199 + io_preparer.py:113-163).
 
 import asyncio
 import logging
+import math
 import os
-from concurrent.futures import Executor
+import threading
+from concurrent.futures import Executor, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -106,6 +108,98 @@ def _is_partitioned(arr: jax.Array) -> bool:
     return not arr.is_fully_replicated
 
 
+# --------------------------------------------------------- chunked transfers
+#
+# A single device→host stream does not saturate the accelerator↔host link
+# (PCIe on TPU VMs, or a network hop when the device is remote); measured
+# here, 16 concurrent chunk streams sustain ~3× the single-stream
+# bandwidth. Large arrays are therefore gathered by slicing on device along
+# the largest dimension and transferring the slices in parallel into a
+# preallocated host buffer. The on-disk payload is unchanged — chunking is
+# purely a staging-transport concern.
+
+_DEFAULT_TRANSFER_CHUNK_BYTES = 32 * 1024 * 1024
+_DEFAULT_TRANSFER_CONCURRENCY = 16
+
+_transfer_pool: Optional[ThreadPoolExecutor] = None
+_transfer_pool_lock = threading.Lock()
+
+
+def _transfer_chunk_bytes() -> int:
+    return int(
+        os.environ.get(
+            "TPUSNAPSHOT_TRANSFER_CHUNK_BYTES", _DEFAULT_TRANSFER_CHUNK_BYTES
+        )
+    )
+
+
+def _get_transfer_pool() -> ThreadPoolExecutor:
+    global _transfer_pool
+    with _transfer_pool_lock:
+        if _transfer_pool is None:
+            _transfer_pool = ThreadPoolExecutor(
+                max_workers=int(
+                    os.environ.get(
+                        "TPUSNAPSHOT_TRANSFER_CONCURRENCY",
+                        _DEFAULT_TRANSFER_CONCURRENCY,
+                    )
+                ),
+                thread_name_prefix="tpusnapshot-d2h",
+            )
+        return _transfer_pool
+
+
+def _should_chunk_transfer(arr: Any) -> bool:
+    if not _is_jax_array(arr):
+        return False
+    try:
+        platform = next(iter(arr.devices())).platform
+    except Exception:  # pragma: no cover - defensive
+        return False
+    if platform == "cpu" and not os.environ.get(
+        "TPUSNAPSHOT_FORCE_CHUNKED_TRANSFER"
+    ):
+        # Host-backed arrays gather via memcpy (often zero-copy); device
+        # slicing would only add copies. Env override exists for tests.
+        return False
+    shape = tuple(arr.shape)
+    if not shape or max(shape) <= 1:
+        return False
+    nbytes = np.dtype(arr.dtype).itemsize * math.prod(shape)
+    return nbytes >= 2 * _transfer_chunk_bytes()
+
+
+def _parallel_device_get(arr: jax.Array) -> np.ndarray:
+    """Gather ``arr`` to host via parallel chunked transfers."""
+    shape = tuple(arr.shape)
+    dtype = np.dtype(arr.dtype)
+    nbytes = dtype.itemsize * math.prod(shape)
+    axis = max(range(len(shape)), key=lambda d: shape[d])
+    n_chunks = min(shape[axis], max(1, -(-nbytes // _transfer_chunk_bytes())))
+    out = np.empty(shape, dtype=dtype)
+    bounds = [round(i * shape[axis] / n_chunks) for i in range(n_chunks + 1)]
+
+    def _fetch(lo: int, hi: int) -> None:
+        piece = jax.lax.slice_in_dim(arr, lo, hi, axis=axis)
+        sel = tuple(
+            slice(lo, hi) if d == axis else slice(None)
+            for d in range(len(shape))
+        )
+        out[sel] = np.asarray(piece)
+
+    pool = _get_transfer_pool()
+    futures = [
+        pool.submit(_fetch, bounds[i], bounds[i + 1])
+        for i in range(n_chunks)
+        if bounds[i] < bounds[i + 1]
+    ]
+    errors = [f.exception() for f in futures]
+    for err in errors:
+        if err is not None:
+            raise err
+    return out
+
+
 class ArrayBufferStager(BufferStager):
     """Stages a device (or host) array into raw payload bytes.
 
@@ -122,15 +216,29 @@ class ArrayBufferStager(BufferStager):
         nbytes: Optional[int] = None,
         entry: Optional[ArrayEntry] = None,
         compression: Optional[str] = None,
+        eager_host_copy: bool = True,
     ) -> None:
         self._data = data
         self._chunk_slices = chunk_slices
         self._compression = compression
         self._entry = entry  # back-patched with the payload checksum
+        self._owns_data = False  # True once rebound to a private copy
         if nbytes is None:
             nbytes = int(np.dtype(data.dtype).itemsize * np.prod(data.shape))
         self._nbytes = nbytes
-        if _is_jax_array(data) and chunk_slices is None:
+        if (
+            eager_host_copy
+            and _is_jax_array(data)
+            and chunk_slices is None
+            and not _should_chunk_transfer(data)
+        ):
+            # Small arrays: start the whole-array async copy now so the
+            # transfer overlaps with scheduling. Large arrays skip this —
+            # they stage via parallel chunked transfers instead, and a
+            # prepare-time whole-array copy would occupy the link with a
+            # slow single stream. Async takes pass eager_host_copy=False:
+            # a device-staged cut rebinds stagers to on-device clones, and
+            # a transfer started on the original would never be consumed.
             try:
                 data.copy_to_host_async()
             except Exception:  # pragma: no cover - platform-dependent
@@ -146,12 +254,23 @@ class ArrayBufferStager(BufferStager):
         data = self._data
         if self._chunk_slices is not None:
             data = data[self._chunk_slices]
-        host = np.asarray(data)  # D2H for jax arrays; no-op for numpy
+        if _should_chunk_transfer(data):
+            host = _parallel_device_get(data)
+        else:
+            host = np.asarray(data)  # D2H for jax arrays; no-op for numpy
         host = np.ascontiguousarray(host)
-        if isinstance(self._data, np.ndarray) and np.shares_memory(host, self._data):
+        if (
+            isinstance(self._data, np.ndarray)
+            and not self._owns_data
+            and np.shares_memory(host, self._data)
+        ):
             # User-owned mutable host memory: copy so the staged buffer is
             # a consistent cut (jax.Arrays are immutable — no copy needed).
             host = host.copy()
+        # Drop the source reference: once the payload is on host, the
+        # device buffer (ours after a device-staged async take, or the
+        # caller's) no longer needs to be pinned by this stager.
+        self._data = None
         # Reinterpret as raw bytes: ml_dtypes dtypes (bfloat16, float8_*)
         # don't export the buffer protocol directly, but a uint8 view does,
         # and it is zero-copy.
@@ -161,14 +280,81 @@ class ArrayBufferStager(BufferStager):
             if self._entry is not None:
                 self._entry.compression = self._compression
         if self._entry is not None:
-            # Staging runs before the manifest all-gather on every path
-            # (sync: writes precede the gather; async: prestage precedes
-            # it), so the checksum lands in the persisted metadata.
+            # The checksum reaches the persisted metadata because staging
+            # always precedes the manifest consolidation: sync takes write
+            # (hence stage) before the manifest all-gather; async takes
+            # serialize each rank's manifest into its completion marker
+            # only after execute_write_reqs finishes (snapshot.py _drain) —
+            # staging may run entirely in that background drain under a
+            # device-staged cut.
             self._entry.checksum = compute_checksum(payload)
         return payload
 
     def get_staging_cost_bytes(self) -> int:
         return self._nbytes
+
+
+def _is_oom_error(exc: BaseException) -> bool:
+    if isinstance(exc, MemoryError):
+        return True
+    text = str(exc)
+    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text
+
+
+def device_clone_write_reqs(write_reqs: List[WriteReq]) -> bool:
+    """Rebind every array stager to a private on-device copy of its data.
+
+    The consistent-cut primitive behind device-staged async snapshots: an
+    HBM→HBM copy runs at memory bandwidth (orders of magnitude faster than
+    device→host), so cloning the checkpoint state on device and draining
+    the device→host staging in the background reduces the training stall
+    from "one full D2H of the app state" to "one HBM copy". The clones own
+    their buffers, so a subsequent training step that donates/deletes the
+    source arrays (jit donation) cannot invalidate the snapshot.
+
+    Host-side numpy data is copied on host (it is mutable user memory).
+    Returns False — with all partial clones released — if the device ran
+    out of memory; the caller falls back to host staging.
+    """
+    import jax.numpy as jnp
+
+    cache: Dict[int, Any] = {}
+    rebinds: List[Tuple[ArrayBufferStager, int]] = []
+    clones: List[Any] = []
+    try:
+        for wr in write_reqs:
+            stager = wr.buffer_stager
+            if not isinstance(stager, ArrayBufferStager) or stager._data is None:
+                continue
+            data = stager._data
+            if _is_jax_array(data):
+                key = id(data)
+                if key not in cache:
+                    cache[key] = jnp.copy(data)
+                    clones.append(cache[key])
+                rebinds.append((stager, key))
+            elif isinstance(data, np.ndarray):
+                stager._data = np.array(data, copy=True)
+                stager._owns_data = True
+        for clone in clones:
+            clone.block_until_ready()
+    except Exception as e:
+        if _is_oom_error(e):
+            for clone in clones:
+                try:
+                    clone.delete()
+                except Exception:  # pragma: no cover
+                    pass
+            logger.warning(
+                "Device-staged snapshot does not fit in device memory; "
+                "falling back to host staging."
+            )
+            return False
+        raise
+    for stager, key in rebinds:
+        stager._data = cache[key]
+        stager._owns_data = True
+    return True
 
 
 class ObjectBufferStager(BufferStager):
@@ -267,6 +453,7 @@ class _ChunkCopyConsumer(BufferConsumer):
         copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Tuple[slice, ...]]],
         checksum: Optional[str] = None,
         compression: Optional[str] = None,
+        on_done: Optional[Callable[[], None]] = None,
     ) -> None:
         # copies: (region, region_slices, view_slices)
         self._view_shape = view_shape
@@ -274,6 +461,7 @@ class _ChunkCopyConsumer(BufferConsumer):
         self._copies = copies
         self._checksum = checksum
         self._compression = compression
+        self._on_done = on_done
         self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
 
     async def consume_buffer(
@@ -309,11 +497,19 @@ class _ChunkCopyConsumer(BufferConsumer):
                 else:
                     region.buffer[region_slices] = view[view_slices]
 
+        def _copy_and_signal() -> None:
+            _copy()
+            # Runs in the executor thread: a finalize triggered here (host→
+            # device assembly) overlaps with reads still in flight instead
+            # of blocking the event loop.
+            if self._on_done is not None:
+                self._on_done()
+
         if executor is not None:
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(executor, _copy)
+            await loop.run_in_executor(executor, _copy_and_signal)
         else:
-            _copy()
+            _copy_and_signal()
 
     def get_consuming_cost_bytes(self) -> int:
         return self._cost
@@ -399,6 +595,19 @@ class ArrayRestorePlan:
             regions[(tuple(off), tuple(shape))] = _TargetRegion(off, shape, self._dtype)
         self._regions = list(regions.values())
         self._chunks = chunks
+        # Eager-finalize bookkeeping: the last chunk consumer to complete
+        # triggers finalize() from its executor thread, so host→device
+        # assembly of this array overlaps with other arrays' reads.
+        self._outstanding = 0
+        self._finalized = False
+        self._lock = threading.Lock()
+
+    def _on_req_done(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+            if self._outstanding != 0:
+                return
+        self.finalize()
 
     def build_read_reqs(self) -> List[ReadReq]:
         reqs: List[ReadReq] = []
@@ -440,6 +649,7 @@ class ArrayRestorePlan:
                         view_shape=list(ov.sizes),
                         dtype=self._dtype,
                         copies=[(region, region_slices, full)],
+                        on_done=self._on_req_done,
                     )
                     reqs.append(
                         ReadReq(
@@ -459,11 +669,21 @@ class ArrayRestorePlan:
                     ],
                     checksum=chunk_checksum,
                     compression=compression,
+                    on_done=self._on_req_done,
                 )
                 reqs.append(ReadReq(path=location, buffer_consumer=consumer))
+        with self._lock:
+            self._outstanding = len(reqs)
         return reqs
 
     def finalize(self) -> None:
+        # Idempotent: normally triggered eagerly by the last chunk consumer;
+        # the finalizer returned by prepare_read is the safety net for plans
+        # with zero read requests.
+        with self._lock:
+            if self._finalized:
+                return
+            self._finalized = True
         if self._template_is_jax:
             # One batched device_put for all shards: the runtime issues the
             # host→device transfers in parallel (a serial per-shard loop is
@@ -506,6 +726,7 @@ def _prepare_dense_array_write(
     rank: int,
     replicated: bool,
     compression: Optional[str] = None,
+    eager_host_copy: bool = True,
 ) -> Tuple[ArrayEntry, List[WriteReq]]:
     prng_impl = None
     if _is_prng_key_array(arr):
@@ -522,12 +743,17 @@ def _prepare_dense_array_write(
     )
     if prng_impl is not None:
         entry.prng_impl = prng_impl
-    stager = ArrayBufferStager(arr, entry=entry, compression=compression)
+    stager = ArrayBufferStager(
+        arr, entry=entry, compression=compression, eager_host_copy=eager_host_copy
+    )
     return entry, [WriteReq(path=location, buffer_stager=stager)]
 
 
 def _prepare_sharded_array_write(
-    arr: jax.Array, logical_path: str, compression: Optional[str] = None
+    arr: jax.Array,
+    logical_path: str,
+    compression: Optional[str] = None,
+    eager_host_copy: bool = True,
 ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
     prng_impl = None
     if _is_prng_key_array(arr):
@@ -546,11 +772,6 @@ def _prepare_sharded_array_write(
         off, sz = index_to_offsets_sizes(shard.index, global_shape)
         pieces = subdivide(off, sz, dtype.itemsize, MAX_CHUNK_SIZE_BYTES)
         whole = len(pieces) == 1
-        if whole:
-            try:
-                shard.data.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
         for c_off, c_sz in pieces:
             location = chunk_location(logical_path, c_off)
             entry = ArrayEntry(
@@ -563,7 +784,10 @@ def _prepare_sharded_array_write(
             shards.append(Shard(offsets=list(c_off), sizes=list(c_sz), array=entry))
             if whole:
                 stager = ArrayBufferStager(
-                    shard.data, entry=entry, compression=compression
+                    shard.data,
+                    entry=entry,
+                    compression=compression,
+                    eager_host_copy=eager_host_copy,
                 )
             else:
                 local = tuple(
@@ -594,12 +818,15 @@ def prepare_write(
     rank: int,
     replicated: bool = False,
     compression: Optional[str] = None,
+    eager_host_copy: bool = True,
 ) -> Tuple[Entry, List[WriteReq]]:
     """Plan the persistence of one leaf value.
 
     Reference analog: io_preparer.py:345-374. Returns the manifest entry
     and the write requests this process is responsible for. For replicated
     values the caller (Snapshot) drops the write reqs on non-owner ranks.
+    ``eager_host_copy=False`` (async takes) suppresses prepare-time
+    device→host copy kickoff — a device-staged cut would never consume it.
     """
     # numpy scalars subclass Python numbers (np.float64 is a float), so the
     # array check must run before the primitive check.
@@ -610,10 +837,12 @@ def prepare_write(
     if isinstance(obj, _PRIMITIVE_TYPES):
         return PrimitiveEntry.from_value(obj, replicated=replicated), []
     if _is_jax_array(obj) and _is_partitioned(obj):
-        return _prepare_sharded_array_write(obj, logical_path, compression)
+        return _prepare_sharded_array_write(
+            obj, logical_path, compression, eager_host_copy
+        )
     if _is_jax_array(obj):
         return _prepare_dense_array_write(
-            obj, logical_path, rank, replicated, compression
+            obj, logical_path, rank, replicated, compression, eager_host_copy
         )
     location = get_storage_path(rank, logical_path, replicated)
     entry = ObjectEntry(

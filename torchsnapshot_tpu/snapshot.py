@@ -25,12 +25,17 @@ Value categories (reference snapshot.py:79-113):
     striped round-robin across processes (snapshot.py:313-359); elastic.
   - **per-rank** — everything else; restore requires the same world size.
 
-Async snapshots (beyond strict parity; BASELINE.json north star): with
-``Snapshot.async_take`` the device→host staging happens synchronously (a
-consistent cut of training state) and storage writes + manifest exchange
-drain on a background thread. Coordination traffic rides the KV store
-(DCN), never XLA collectives, so background coordination cannot deadlock
-with the training step's ICI collectives.
+Async snapshots (beyond strict parity; BASELINE.json north star):
+``Snapshot.async_take`` captures a consistent cut of training state before
+returning — by default (``stage="auto"``/``"device"``) as on-device HBM
+clones, so the stall is one device-side copy and the device→host staging
+itself drains on the background thread (HBM transiently holds the clones;
+each is released as its payload reaches host); with ``stage="host"`` by
+staging every buffer to host RAM up front. Storage writes and the manifest
+consolidation always drain in the background. Foreground coordination
+rides the KV store (DCN), never XLA collectives, so it cannot deadlock
+with the training step's ICI collectives; background cross-rank signaling
+goes through storage completion markers, never the coordinator.
 """
 
 import asyncio
@@ -42,7 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .coord import Coordinator, get_coordinator
 from .flatten import flatten, inflate
-from .io_preparer import prepare_read, prepare_write
+from .io_preparer import device_clone_write_reqs, prepare_read, prepare_write
 from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
 from .manifest import (
     DictEntry,
@@ -127,15 +132,32 @@ class Snapshot:
         coord: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         compression: Optional[str] = None,
+        stage: str = "auto",
     ) -> "PendingSnapshot":
         """Take a snapshot with storage writes overlapped with training.
 
-        Device→host staging runs synchronously so the caller gets back a
-        consistent cut of the state; writes, the manifest exchange, and the
-        metadata commit drain on a background thread. Call ``.wait()`` (or
-        check ``.done()``) before depending on the snapshot.
+        The caller gets back a consistent cut of the state; writes, the
+        manifest exchange, and the metadata commit drain on a background
+        thread. Call ``.wait()`` (or check ``.done()``) before depending on
+        the snapshot.
+
+        ``stage`` selects how the consistent cut is captured:
+
+        - ``"device"`` — clone device arrays HBM→HBM (memory-bandwidth
+          fast; the stall is one on-device copy) and drain the device→host
+          staging in the background. Transiently needs device memory for
+          the clones; clones are released as their payloads reach host.
+        - ``"host"`` — stage everything to host RAM before returning (the
+          stall is one full device→host copy of the app state; no extra
+          device memory).
+        - ``"auto"`` (default) — try device cloning, fall back to host
+          staging if the clones do not fit in device memory.
         """
         check_compression(compression)
+        if stage not in ("auto", "host", "device"):
+            raise ValueError(
+                f'stage must be "auto", "host", or "device"; got {stage!r}'
+            )
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
         storage = url_to_storage_plugin(path)
@@ -149,6 +171,7 @@ class Snapshot:
                 replicated=replicated or [],
                 background=background,
                 compression=compression,
+                stage=stage,
             )
         except BaseException:
             storage.close()
@@ -167,6 +190,7 @@ class Snapshot:
         replicated: List[str],
         background: Optional["_BackgroundTake"],
         compression: Optional[str] = None,
+        stage: str = "auto",
     ) -> None:
         app_state = dict(app_state)
         rank = coordinator.get_rank()
@@ -197,6 +221,7 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
+                eager_host_copy=background is None,
             )
 
         global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
@@ -211,6 +236,7 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
+                eager_host_copy=background is None,
             )
             coordinator.barrier()
 
@@ -232,31 +258,30 @@ class Snapshot:
             coordinator.barrier()
         else:
             # Async take. All *collectives* run in the foreground (they are
-            # kilobytes over the KV store); only storage writes drain in the
-            # background. Cross-rank write completion is signalled through
-            # storage markers, NOT coordinator collectives — a background
-            # thread must never race the coordinator against foreground
-            # snapshot operations.
+            # kilobytes over the KV store); storage writes and the manifest
+            # consolidation drain in the background. Cross-rank background
+            # coordination rides storage markers, NOT coordinator
+            # collectives — a background thread must never race the
+            # coordinator against foreground snapshot operations.
             #
-            # Consistency: every buffer is staged to host *now*. Holding
-            # device arrays lazily would break under jit buffer donation
-            # (the next training step deletes the snapshotted buffers), so
-            # the stall equals one HBM→host copy of the app state and host
-            # RAM must fit the per-host checkpoint size (a warning is
-            # logged when it exceeds the memory budget). Use Snapshot.take
-            # when host memory is the constraint.
-            _prestage_write_reqs(pending_write_reqs, budget)
+            # Consistency: the cut is captured *now* — either by cloning
+            # device arrays on device (fast HBM copy; background drain
+            # stages from the clones) or by staging every buffer to host.
+            # Holding the caller's device arrays lazily would break under
+            # jit buffer donation (the next training step deletes the
+            # snapshotted buffers).
+            _prestage_write_reqs(
+                pending_write_reqs, budget, stage=stage, coordinator=coordinator
+            )
 
             # Per-take nonce: completion markers and the metadata document
             # from concurrent/previous takes to the same path must never
             # satisfy this take's polls (the nonce is recorded as the
-            # metadata's take_id, making successive takes' YAML distinct
-            # even when their manifests are byte-identical).
+            # metadata's take_id, which wait() matches on).
             nonce = coordinator.broadcast_object(
                 uuid.uuid4().hex if rank == 0 else None, src=0
             )
-            metadata = _gather_manifest(coordinator, manifest, take_id=nonce)
-            background.expected_metadata_yaml = metadata.to_yaml()
+            background.take_id = nonce
             world_size = coordinator.get_world_size()
 
             def _drain() -> None:
@@ -264,12 +289,32 @@ class Snapshot:
                     await execute_write_reqs(
                         pending_write_reqs, storage, budget, rank
                     )
+                    # The completion marker carries this rank's local
+                    # manifest. It must be serialized *after* this rank's
+                    # writes finish: staging back-patches payload checksums
+                    # into the entries, and under a device-staged cut
+                    # staging itself runs in this background drain.
                     marker = IOReq(path=f".completed/{nonce}/{rank}")
-                    marker.buf.write(b"1")
+                    marker.buf.write(
+                        SnapshotMetadata(
+                            version=__version__,
+                            world_size=world_size,
+                            manifest=manifest,
+                            take_id=nonce,
+                        )
+                        .to_yaml()
+                        .encode("utf-8")
+                    )
                     await storage.write(marker)
                     if rank == 0:
-                        await _wait_for_completion_markers(
+                        all_manifests = await _collect_completion_manifests(
                             storage, world_size, nonce
+                        )
+                        metadata = SnapshotMetadata(
+                            version=__version__,
+                            world_size=world_size,
+                            manifest=_merge_manifests(all_manifests),
+                            take_id=nonce,
                         )
                         await _awrite_snapshot_metadata(storage, metadata)
                         for r in range(world_size):
@@ -431,10 +476,10 @@ class _BackgroundTake:
     def __init__(self) -> None:
         self.thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
-        # The metadata document rank 0 will commit — identical on every
-        # rank (deterministic YAML of the all-gathered manifest), so any
-        # rank can recognize *this* take's commit vs a stale one.
-        self.expected_metadata_yaml: Optional[str] = None
+        # This take's nonce, recorded as the committed metadata's take_id —
+        # broadcast to every rank, so any rank can recognize *this* take's
+        # commit vs a stale document at the same path.
+        self.take_id: Optional[str] = None
 
     def start(self, fn: Callable[[], None]) -> None:
         def _run() -> None:
@@ -484,7 +529,7 @@ class PendingSnapshot:
                 asyncio.run(
                     _wait_for_metadata(
                         self._storage,
-                        expected_yaml=self._background.expected_metadata_yaml,
+                        take_id=self._background.take_id,
                         timeout_s=timeout_s,
                     )
                 )
@@ -566,6 +611,7 @@ def _save_stateful(
     manifest_out: Manifest,
     write_reqs_out: List[WriteReq],
     compression: Optional[str] = None,
+    eager_host_copy: bool = True,
 ) -> None:
     # A rank without this stateful still participates in the negotiation
     # collective below (with an empty path set) so coordinator operation
@@ -597,6 +643,7 @@ def _save_stateful(
             rank=rank,
             replicated=replicated,
             compression=compression,
+            eager_host_copy=eager_host_copy,
         )
         if isinstance(entry, ShardedArrayEntry):
             replicated = False
@@ -632,44 +679,63 @@ def _is_not_found_error(exc: BaseException) -> bool:
     return "404" in text or "NoSuchKey" in text or "Not Found" in text
 
 
-async def _wait_for_completion_markers(
+async def _collect_completion_manifests(
     storage: StoragePlugin,
     world_size: int,
     nonce: str,
     timeout_s: float = _COMPLETION_TIMEOUT_S,
-) -> None:
-    """Poll storage until every rank's write-completion marker exists."""
+) -> List[Manifest]:
+    """Poll storage until every rank's completion marker exists; return the
+    local manifests the markers carry (rank order)."""
     import time as _time
 
     deadline = _time.monotonic() + timeout_s
+    manifests: List[Manifest] = []
     for r in range(world_size):
         path = f".completed/{nonce}/{r}"
         delay = 0.02
         while True:
+            marker: Optional[SnapshotMetadata] = None
             try:
-                await storage.read(IOReq(path=path))
-                break
+                io_req = IOReq(path=path)
+                await storage.read(io_req)
+                doc = bytes(io_payload(io_req)).decode("utf-8", errors="replace")
+                try:
+                    # A partially-visible document (non-atomic storage
+                    # visibility) parses as garbage or carries a stale
+                    # take_id: keep polling, same as _wait_for_metadata.
+                    candidate = SnapshotMetadata.from_yaml(doc)
+                    if candidate.take_id == nonce:
+                        marker = candidate
+                except Exception:
+                    marker = None
             except Exception as e:
                 if not _is_not_found_error(e):
                     raise
-                if _time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"Timed out waiting for rank {r}'s snapshot writes "
-                        f"to complete (marker {path} absent)."
-                    )
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, 1.0)
+            if marker is not None:
+                manifests.append(marker.manifest)
+                break
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Timed out waiting for rank {r}'s snapshot writes "
+                    f"to complete (marker {path} absent or stale)."
+                )
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 1.0)
+    return manifests
 
 
 async def _wait_for_metadata(
     storage: StoragePlugin,
-    expected_yaml: Optional[str],
+    take_id: Optional[str],
     timeout_s: float = _COMPLETION_TIMEOUT_S,
 ) -> None:
     """Poll storage until *this take's* metadata commit is observable.
 
-    Matching on content (not existence) prevents a previous take's stale
-    metadata at the same path from satisfying the wait."""
+    Matching on the embedded take_id (not mere existence) prevents a
+    previous take's stale metadata at the same path from satisfying the
+    wait. Unparseable content is treated as stale/in-flight (a concurrent
+    non-atomic filesystem write can expose a partial document)."""
     import time as _time
 
     deadline = _time.monotonic() + timeout_s
@@ -678,8 +744,14 @@ async def _wait_for_metadata(
         try:
             io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
             await storage.read(io_req)
-            content = bytes(io_payload(io_req)).decode("utf-8")
-            if expected_yaml is None or content == expected_yaml:
+            content = bytes(io_payload(io_req)).decode("utf-8", errors="replace")
+            try:
+                metadata = SnapshotMetadata.from_yaml(content)
+            except Exception:
+                metadata = None  # partial/corrupt document: keep polling
+            if metadata is not None and (
+                take_id is None or metadata.take_id == take_id
+            ):
                 return
         except Exception as e:
             if not _is_not_found_error(e):
@@ -693,12 +765,41 @@ async def _wait_for_metadata(
         delay = min(delay * 2, 1.0)
 
 
-def _prestage_write_reqs(write_reqs: List[WriteReq], budget: int) -> None:
-    """Eagerly stage every buffer to host (async take's consistent cut).
+def _prestage_write_reqs(
+    write_reqs: List[WriteReq],
+    budget: int,
+    stage: str = "auto",
+    coordinator: Optional[Coordinator] = None,
+) -> None:
+    """Capture async take's consistent cut (device clones or host staging).
 
-    Concurrency is bounded by the staging thread pool; total retained host
-    memory necessarily equals the per-process checkpoint size (every
-    buffer must exist on host before control returns to training)."""
+    Device mode rebinds array stagers to on-device clones — the stall is
+    one HBM copy, and the background drain stages from the clones (each
+    clone is released as soon as its payload reaches host). Host mode
+    eagerly stages every buffer to host: concurrency is bounded by the
+    staging thread pool; total retained host memory necessarily equals the
+    per-process checkpoint size.
+
+    The device-vs-host decision is *collective*: HBM pressure is
+    rank-local, and a rank falling back (or raising) unilaterally between
+    collectives would desynchronize the coordinator. Every rank gathers
+    every rank's clone result and they all take the same branch — ranks
+    whose clones succeeded simply stage from the clones on the host path.
+    ``stage`` must therefore be uniform across ranks (like ``replicated``
+    globs and every other collective argument).
+    """
+    coordinator = get_coordinator(coordinator)
+    cloned = stage != "host" and device_clone_write_reqs(write_reqs)
+    all_cloned = all(coordinator.all_gather_object(cloned))
+    if all_cloned and stage != "host":
+        return
+    if stage == "device":
+        # Collective raise: every rank saw the same gather and raises.
+        raise RuntimeError(
+            "stage='device' was requested but the on-device clones did "
+            "not fit in device memory on at least one rank. Use "
+            "stage='auto' or 'host'."
+        )
     total = sum(wr.buffer_stager.get_staging_cost_bytes() for wr in write_reqs)
     if total > budget:
         logger.warning(
@@ -795,19 +896,14 @@ def _load_stateful(
     stateful.load_state_dict(new_state_dict)
 
 
-def _gather_manifest(
-    coordinator: Coordinator,
-    local_manifest: Manifest,
-    take_id: Optional[str] = None,
-) -> SnapshotMetadata:
-    """All-gather per-process manifests into the global rank-prefixed view.
+def _merge_manifests(all_manifests: List[Manifest]) -> Manifest:
+    """Merge per-process manifests into the global rank-prefixed view.
 
     Replicated entries are mirrored into every rank's namespace so any
     rank can resolve them after an elastic restore (reference
     snapshot.py:507-527).
     """
-    world_size = coordinator.get_world_size()
-    all_manifests = coordinator.all_gather_object(local_manifest)
+    world_size = len(all_manifests)
     global_manifest: Manifest = {}
     replicated_entries: Dict[str, Entry] = {}
     for owner_rank, m in enumerate(all_manifests):
@@ -825,10 +921,20 @@ def _gather_manifest(
     for logical_path, entry in replicated_entries.items():
         for r in range(world_size):
             global_manifest.setdefault(f"{r}/{logical_path}", entry)
+    return global_manifest
+
+
+def _gather_manifest(
+    coordinator: Coordinator,
+    local_manifest: Manifest,
+    take_id: Optional[str] = None,
+) -> SnapshotMetadata:
+    """All-gather per-process manifests and merge (sync-take commit path)."""
+    all_manifests = coordinator.all_gather_object(local_manifest)
     return SnapshotMetadata(
         version=__version__,
-        world_size=world_size,
-        manifest=global_manifest,
+        world_size=coordinator.get_world_size(),
+        manifest=_merge_manifests(all_manifests),
         take_id=take_id,
     )
 
