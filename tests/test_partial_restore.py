@@ -1,0 +1,147 @@
+"""Partial restore (``Snapshot.restore(paths=...)``) and container reads
+via ``Snapshot.read_object`` (beyond-parity random-access features)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def _app():
+    return {
+        "model": _Holder(
+            {
+                "layers": {
+                    "w0": jnp.arange(8.0),
+                    "w1": jnp.arange(8.0) * 2,
+                },
+                "head": jnp.arange(4.0),
+            }
+        ),
+        "optim": _Holder({"mu": jnp.ones(8), "step": 7}),
+    }
+
+
+@pytest.fixture
+def snap_path(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, _app())
+    return path
+
+
+def test_partial_restore_glob(snap_path):
+    target = {
+        "model": _Holder(
+            {
+                "layers": {"w0": jnp.zeros(8), "w1": jnp.zeros(8)},
+                "head": jnp.zeros(4),
+            }
+        ),
+        "optim": _Holder({"mu": jnp.zeros(8), "step": -1}),
+    }
+    Snapshot(snap_path).restore(target, paths=["model/layers/**"])
+    sd = target["model"].sd
+    np.testing.assert_array_equal(np.asarray(sd["layers"]["w0"]), np.arange(8.0))
+    np.testing.assert_array_equal(
+        np.asarray(sd["layers"]["w1"]), np.arange(8.0) * 2
+    )
+    # Outside the filter: untouched.
+    np.testing.assert_array_equal(np.asarray(sd["head"]), np.zeros(4))
+    assert target["optim"].sd["step"] == -1
+    np.testing.assert_array_equal(np.asarray(target["optim"].sd["mu"]), np.zeros(8))
+
+
+def test_partial_restore_whole_stateful(snap_path):
+    target = {
+        "model": _Holder(
+            {
+                "layers": {"w0": jnp.zeros(8), "w1": jnp.zeros(8)},
+                "head": jnp.zeros(4),
+            }
+        ),
+        "optim": _Holder({"mu": jnp.zeros(8), "step": -1}),
+    }
+    Snapshot(snap_path).restore(target, paths=["optim/**"])
+    assert target["optim"].sd["step"] == 7
+    np.testing.assert_array_equal(np.asarray(target["optim"].sd["mu"]), np.ones(8))
+    np.testing.assert_array_equal(
+        np.asarray(target["model"].sd["layers"]["w0"]), np.zeros(8)
+    )
+
+
+def test_partial_restore_missing_selected_path_still_errors(snap_path):
+    target = {"model": _Holder({"layers": {"nonexistent": jnp.zeros(3)}})}
+    with pytest.raises(RuntimeError, match="Unable to find an entry"):
+        Snapshot(snap_path).restore(target, paths=["model/**"])
+
+
+def test_partial_restore_filter_excludes_missing_path(snap_path):
+    # The same missing path filtered OUT does not error.
+    target = {
+        "model": _Holder(
+            {
+                "layers": {
+                    "w0": jnp.zeros(8),
+                    "w1": jnp.zeros(8),
+                    "nonexistent": jnp.zeros(3),
+                },
+                "head": jnp.zeros(4),
+            }
+        )
+    }
+    Snapshot(snap_path).restore(target, paths=["model/head"])
+    np.testing.assert_array_equal(np.asarray(target["model"].sd["head"]), np.arange(4.0))
+
+
+def test_read_object_container(snap_path):
+    layers = Snapshot(snap_path).read_object("model/layers")
+    assert set(layers.keys()) == {"w0", "w1"}
+    np.testing.assert_array_equal(np.asarray(layers["w0"]), np.arange(8.0))
+    np.testing.assert_array_equal(np.asarray(layers["w1"]), np.arange(8.0) * 2)
+
+
+def test_read_object_container_with_primitives_and_objects(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(
+        path,
+        {
+            "st": StateDict(
+                epoch=3,
+                name="run-a",
+                nested={"xs": [1, 2, 3], "arr": np.arange(5.0)},
+            )
+        },
+    )
+    nested = Snapshot(path).read_object("st/nested")
+    assert nested["xs"] == [1, 2, 3]
+    np.testing.assert_array_equal(nested["arr"], np.arange(5.0))
+    whole = Snapshot(path).read_object("st")
+    assert whole["epoch"] == 3
+    assert whole["name"] == "run-a"
+
+
+def test_read_object_container_rejects_template(snap_path):
+    with pytest.raises(ValueError, match="container"):
+        Snapshot(snap_path).read_object("model/layers", template=jnp.zeros(8))
+
+
+def test_partial_restore_no_match_raises(snap_path):
+    target = {
+        "model": _Holder({"layers": {"w0": jnp.zeros(8), "w1": jnp.zeros(8)},
+                          "head": jnp.zeros(4)}),
+    }
+    with pytest.raises(RuntimeError, match="matched no leaf"):
+        Snapshot(snap_path).restore(target, paths=["Model/**"])  # typo'd case

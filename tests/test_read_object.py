@@ -66,9 +66,13 @@ def test_read_missing_raises_with_suggestions(snap):
         snap.read_object("m/nope")
 
 
-def test_read_container_raises(snap):
-    with pytest.raises(ValueError, match="is a container"):
-        snap.read_object("m")
+def test_read_container_assembles_subtree(snap):
+    out = snap.read_object("m")
+    assert set(out.keys()) == {"w", "sharded", "obj", "count"}
+    np.testing.assert_array_equal(out["w"], np.arange(24.0).reshape(4, 6))
+    np.testing.assert_array_equal(out["sharded"], np.arange(64.0).reshape(16, 4))
+    assert out["obj"] == {1, 2, 3}
+    assert out["count"] == 5
 
 
 def test_inspect_cli(snap, capsys):

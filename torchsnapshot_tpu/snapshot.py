@@ -336,11 +336,19 @@ class Snapshot:
     # --------------------------------------------------------------- restore
 
     def restore(
-        self, app_state: AppState, coord: Optional[Coordinator] = None
+        self,
+        app_state: AppState,
+        coord: Optional[Coordinator] = None,
+        paths: Optional[List[str]] = None,
     ) -> None:
         """Restore ``app_state`` in place from this snapshot.
 
-        Reference analog: snapshot.py:226-269.
+        Reference analog: snapshot.py:226-269. ``paths`` (beyond parity)
+        optionally filters the restore to logical paths matching any of
+        the given globs (e.g. ``["model/**"]`` to load parameters but not
+        optimizer state); non-matching leaves keep their current values.
+        Globs use the same namespace as ``replicated`` and
+        :meth:`read_object`: ``"<stateful_key>/<flattened/path>"``.
         """
         coordinator = get_coordinator(coord if coord is not None else self._coord)
         rank = coordinator.get_rank()
@@ -354,10 +362,11 @@ class Snapshot:
 
             global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
             budget = get_process_memory_budget_bytes(coordinator)
+            n_selected = 0
             for key in global_keys:
                 stateful = app_state.get(key)
                 if stateful is not None:
-                    _load_stateful(
+                    n_selected += _load_stateful(
                         key=key,
                         stateful=stateful,
                         available=available,
@@ -366,13 +375,14 @@ class Snapshot:
                         rank=rank,
                         world_size=coordinator.get_world_size(),
                         snapshot_world_size=metadata.world_size,
+                        path_globs=paths,
                     )
                 coordinator.barrier()
 
             # RNG state is restored last so that no other stateful's
             # load_state_dict() perturbs it (reference snapshot.py:258-268).
             if rng_stateful is not None:
-                _load_stateful(
+                n_selected += _load_stateful(
                     key=rng_key,
                     stateful=rng_stateful,
                     available=available,
@@ -381,6 +391,18 @@ class Snapshot:
                     rank=rank,
                     world_size=coordinator.get_world_size(),
                     snapshot_world_size=metadata.world_size,
+                    path_globs=paths,
+                )
+            if paths is not None and n_selected == 0:
+                # A filter that matches nothing is almost certainly a typo
+                # (wrong case, stale key); a silent no-op would let training
+                # "resume" from fresh weights. All collectives above already
+                # completed, so raising here cannot desynchronize ranks.
+                raise RuntimeError(
+                    f"restore(paths={paths!r}) matched no leaf in the "
+                    f"app_state. Leaves are named "
+                    f'"<stateful_key>/<flattened/path>", e.g. '
+                    f'"model/params/w"; see get_manifest().'
                 )
         finally:
             storage.close()
@@ -435,16 +457,62 @@ class Snapshot:
                     f"{rank}). Available leaves include: {preview}"
                 )
             entry = available[logical_path]
+            budget = get_local_memory_budget_bytes()
             if isinstance(entry, (ListEntry, DictEntry)):
-                raise ValueError(
-                    f'"{logical_path}" is a container; read_object fetches '
-                    f"leaves. Use get_manifest() to enumerate its children."
-                )
+                # Container: read every leaf beneath it and inflate the
+                # subtree (templates supply placements leaf-by-leaf only
+                # for exact-path reads, so a container read returns host
+                # values).
+                if template is not None:
+                    raise ValueError(
+                        f'"{logical_path}" is a container; pass '
+                        f"template=None (container reads return host "
+                        f"values) or read leaves individually."
+                    )
+                prefix = logical_path + "/"
+                containers: Manifest = {}
+                flattened: Dict[str, Any] = {}
+                reqs: List[ReadReq] = []
+                finalizers: List[Callable[[], None]] = []
+                for p, e in available.items():
+                    if p != logical_path and not p.startswith(prefix):
+                        continue
+                    if isinstance(e, (ListEntry, DictEntry)):
+                        containers[p] = e
+                        continue
+
+                    def _cb(value: Any, p: str = p) -> None:
+                        flattened[p] = value
+
+                    r, f = prepare_read(entry=e, template=None, callback=_cb)
+                    reqs.extend(r)
+                    finalizers.extend(f)
+                # Every child a dict container advertises must have
+                # resolved for this rank — otherwise inflate would hand
+                # back silent Nones (e.g. per-rank leaves read with a rank
+                # that doesn't own them). List containers carry no child
+                # inventory; a gap there fails inside inflate instead.
+                unresolved = [
+                    f"{p}/{k}"
+                    for p, e in containers.items()
+                    if isinstance(e, DictEntry)
+                    for k in e.keys
+                    if f"{p}/{k}" not in available
+                ]
+                if unresolved:
+                    raise KeyError(
+                        f'"{logical_path}" cannot be fully assembled for '
+                        f"rank {rank}; missing leaves: "
+                        f"{', '.join(sorted(unresolved)[:10])}"
+                    )
+                asyncio.run(execute_read_reqs(reqs, storage, budget, rank))
+                for finalize in finalizers:
+                    finalize()
+                return inflate(containers, flattened, prefix=logical_path)
             result: Dict[str, Any] = {}
             reqs, finalizers = prepare_read(
                 entry=entry, template=template, callback=lambda v: result.update(v=v)
             )
-            budget = get_local_memory_budget_bytes()
             asyncio.run(execute_read_reqs(reqs, storage, budget, rank))
             for finalize in finalizers:
                 finalize()
@@ -847,7 +915,9 @@ def _load_stateful(
     rank: int,
     world_size: int,
     snapshot_world_size: int,
-) -> None:
+    path_globs: Optional[List[str]] = None,
+) -> int:
+    """Returns the number of leaves restored (callers detect no-op filters)."""
     # In-place restore strategy (reference snapshot.py:374-381): the
     # template state dict supplies dtypes/shapes/shardings so restored
     # arrays land directly on the right devices with the right layout.
@@ -856,7 +926,20 @@ def _load_stateful(
 
     read_reqs: List[ReadReq] = []
     finalizers: List[Callable[[], None]] = []
+    selected = set(flattened)
+    if path_globs is not None:
+        selected = {
+            p
+            for p in flattened
+            if any(fnmatch.fnmatch(p, g) for g in path_globs)
+        }
+        if not selected:
+            # Nothing of this stateful matches the filter: leave it
+            # untouched (no load_state_dict call, no side effects).
+            return 0
     for logical_path, template in flattened.items():
+        if logical_path not in selected:
+            continue  # partial restore: keep the template's value
         if logical_path not in available:
             raise RuntimeError(
                 f'Unable to find an entry for "{logical_path}" for rank '
@@ -883,17 +966,21 @@ def _load_stateful(
 
     # Prefer the snapshot's container entries for inflation so saved
     # structure (e.g. dict key sets) round-trips; fall back to the
-    # template's for paths the snapshot lacks.
-    snapshot_containers = {
-        path: entry
-        for path, entry in available.items()
-        if isinstance(entry, (ListEntry, DictEntry))
-        and (path == key or path.startswith(key + "/"))
-    }
+    # template's for paths the snapshot lacks. Partial restores keep the
+    # template's structure outright — unrestored subtrees hold template
+    # values, which the snapshot's key sets need not describe.
     inflate_manifest = dict(container_manifest)
-    inflate_manifest.update(snapshot_containers)
+    if path_globs is None:
+        snapshot_containers = {
+            path: entry
+            for path, entry in available.items()
+            if isinstance(entry, (ListEntry, DictEntry))
+            and (path == key or path.startswith(key + "/"))
+        }
+        inflate_manifest.update(snapshot_containers)
     new_state_dict = inflate(inflate_manifest, flattened, prefix=key)
     stateful.load_state_dict(new_state_dict)
+    return len(selected)
 
 
 def _merge_manifests(all_manifests: List[Manifest]) -> Manifest:
