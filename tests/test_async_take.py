@@ -145,3 +145,56 @@ def test_async_take_invalid_stage(tmp_path):
         Snapshot.async_take(
             str(tmp_path / "snap"), {"m": _Holder({})}, stage="bogus"
         )
+
+
+@pytest.mark.parametrize("stage", ["device", "host"])
+def test_async_take_sharded_array(tmp_path, stage):
+    """Device-staged async take of a partitioned array: clones preserve
+    sharding; the snapshot survives deletion of the source (donation)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    arr = jax.device_put(
+        jnp.arange(64.0).reshape(8, 8), NamedSharding(mesh, P("x", None))
+    )
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"m": _Holder({"w": arr})}, stage=stage
+    )
+    arr.delete()
+    snap = pending.wait()
+
+    # Elastic restore onto a smaller mesh.
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("x",))
+    template = jax.device_put(
+        jnp.zeros((8, 8)), NamedSharding(mesh2, P(None, "x"))
+    )
+    target = _Holder({"w": template})
+    snap.restore({"m": target})
+    np.testing.assert_array_equal(
+        np.asarray(target.sd["w"]), np.arange(64.0).reshape(8, 8)
+    )
+
+
+def test_async_take_background_write_failure_surfaces(tmp_path, monkeypatch):
+    """A storage failure in the background drain must surface on wait(),
+    and no metadata commit may appear (the snapshot stays invisible)."""
+    import os
+    import torchsnapshot_tpu.snapshot as snap_mod
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    class _FailingFS(FSStoragePlugin):
+        async def write(self, io_req):
+            if not io_req.path.startswith(".completed"):
+                raise IOError("disk on fire")
+            await super().write(io_req)
+
+    monkeypatch.setattr(
+        snap_mod, "url_to_storage_plugin", lambda path: _FailingFS(path)
+    )
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"m": _Holder({"w": jnp.arange(16.0)})}
+    )
+    with pytest.raises(IOError, match="disk on fire"):
+        pending.wait()
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")

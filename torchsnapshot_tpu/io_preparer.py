@@ -34,10 +34,9 @@ restore (reference analog: resharding.py:135-199 + io_preparer.py:113-163).
 
 import asyncio
 import logging
-import math
 import os
 import threading
-from concurrent.futures import Executor, ThreadPoolExecutor
+from concurrent.futures import Executor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -45,6 +44,7 @@ import numpy as np
 import jax
 
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .ops.transfer import device_clone, parallel_device_get, should_chunk_transfer
 from .manifest import (
     ArrayEntry,
     Entry,
@@ -108,96 +108,10 @@ def _is_partitioned(arr: jax.Array) -> bool:
     return not arr.is_fully_replicated
 
 
-# --------------------------------------------------------- chunked transfers
-#
-# A single device→host stream does not saturate the accelerator↔host link
-# (PCIe on TPU VMs, or a network hop when the device is remote); measured
-# here, 16 concurrent chunk streams sustain ~3× the single-stream
-# bandwidth. Large arrays are therefore gathered by slicing on device along
-# the largest dimension and transferring the slices in parallel into a
-# preallocated host buffer. The on-disk payload is unchanged — chunking is
-# purely a staging-transport concern.
-
-_DEFAULT_TRANSFER_CHUNK_BYTES = 32 * 1024 * 1024
-_DEFAULT_TRANSFER_CONCURRENCY = 16
-
-_transfer_pool: Optional[ThreadPoolExecutor] = None
-_transfer_pool_lock = threading.Lock()
-
-
-def _transfer_chunk_bytes() -> int:
-    return int(
-        os.environ.get(
-            "TPUSNAPSHOT_TRANSFER_CHUNK_BYTES", _DEFAULT_TRANSFER_CHUNK_BYTES
-        )
-    )
-
-
-def _get_transfer_pool() -> ThreadPoolExecutor:
-    global _transfer_pool
-    with _transfer_pool_lock:
-        if _transfer_pool is None:
-            _transfer_pool = ThreadPoolExecutor(
-                max_workers=int(
-                    os.environ.get(
-                        "TPUSNAPSHOT_TRANSFER_CONCURRENCY",
-                        _DEFAULT_TRANSFER_CONCURRENCY,
-                    )
-                ),
-                thread_name_prefix="tpusnapshot-d2h",
-            )
-        return _transfer_pool
-
-
-def _should_chunk_transfer(arr: Any) -> bool:
-    if not _is_jax_array(arr):
-        return False
-    try:
-        platform = next(iter(arr.devices())).platform
-    except Exception:  # pragma: no cover - defensive
-        return False
-    if platform == "cpu" and not os.environ.get(
-        "TPUSNAPSHOT_FORCE_CHUNKED_TRANSFER"
-    ):
-        # Host-backed arrays gather via memcpy (often zero-copy); device
-        # slicing would only add copies. Env override exists for tests.
-        return False
-    shape = tuple(arr.shape)
-    if not shape or max(shape) <= 1:
-        return False
-    nbytes = np.dtype(arr.dtype).itemsize * math.prod(shape)
-    return nbytes >= 2 * _transfer_chunk_bytes()
-
-
-def _parallel_device_get(arr: jax.Array) -> np.ndarray:
-    """Gather ``arr`` to host via parallel chunked transfers."""
-    shape = tuple(arr.shape)
-    dtype = np.dtype(arr.dtype)
-    nbytes = dtype.itemsize * math.prod(shape)
-    axis = max(range(len(shape)), key=lambda d: shape[d])
-    n_chunks = min(shape[axis], max(1, -(-nbytes // _transfer_chunk_bytes())))
-    out = np.empty(shape, dtype=dtype)
-    bounds = [round(i * shape[axis] / n_chunks) for i in range(n_chunks + 1)]
-
-    def _fetch(lo: int, hi: int) -> None:
-        piece = jax.lax.slice_in_dim(arr, lo, hi, axis=axis)
-        sel = tuple(
-            slice(lo, hi) if d == axis else slice(None)
-            for d in range(len(shape))
-        )
-        out[sel] = np.asarray(piece)
-
-    pool = _get_transfer_pool()
-    futures = [
-        pool.submit(_fetch, bounds[i], bounds[i + 1])
-        for i in range(n_chunks)
-        if bounds[i] < bounds[i + 1]
-    ]
-    errors = [f.exception() for f in futures]
-    for err in errors:
-        if err is not None:
-            raise err
-    return out
+# Chunked-transfer + clone primitives live in ops/transfer.py; private
+# aliases keep this module's call sites short.
+_should_chunk_transfer = should_chunk_transfer
+_parallel_device_get = parallel_device_get
 
 
 class ArrayBufferStager(BufferStager):
@@ -294,13 +208,6 @@ class ArrayBufferStager(BufferStager):
         return self._nbytes
 
 
-def _is_oom_error(exc: BaseException) -> bool:
-    if isinstance(exc, MemoryError):
-        return True
-    text = str(exc)
-    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text
-
-
 def device_clone_write_reqs(write_reqs: List[WriteReq]) -> bool:
     """Rebind every array stager to a private on-device copy of its data.
 
@@ -316,43 +223,30 @@ def device_clone_write_reqs(write_reqs: List[WriteReq]) -> bool:
     Returns False — with all partial clones released — if the device ran
     out of memory; the caller falls back to host staging.
     """
-    import jax.numpy as jnp
-
-    cache: Dict[int, Any] = {}
+    sources: Dict[int, Any] = {}
     rebinds: List[Tuple[ArrayBufferStager, int]] = []
-    clones: List[Any] = []
-    try:
-        for wr in write_reqs:
-            stager = wr.buffer_stager
-            if not isinstance(stager, ArrayBufferStager) or stager._data is None:
-                continue
-            data = stager._data
-            if _is_jax_array(data):
-                key = id(data)
-                if key not in cache:
-                    cache[key] = jnp.copy(data)
-                    clones.append(cache[key])
-                rebinds.append((stager, key))
-            elif isinstance(data, np.ndarray):
-                stager._data = np.array(data, copy=True)
-                stager._owns_data = True
-        for clone in clones:
-            clone.block_until_ready()
-    except Exception as e:
-        if _is_oom_error(e):
-            for clone in clones:
-                try:
-                    clone.delete()
-                except Exception:  # pragma: no cover
-                    pass
-            logger.warning(
-                "Device-staged snapshot does not fit in device memory; "
-                "falling back to host staging."
-            )
-            return False
-        raise
+    for wr in write_reqs:
+        stager = wr.buffer_stager
+        if not isinstance(stager, ArrayBufferStager) or stager._data is None:
+            continue
+        data = stager._data
+        if _is_jax_array(data):
+            sources.setdefault(id(data), data)
+            rebinds.append((stager, id(data)))
+        elif isinstance(data, np.ndarray):
+            stager._data = np.array(data, copy=True)
+            stager._owns_data = True
+    order = list(sources)
+    clones = device_clone([sources[k] for k in order])
+    if clones is None:
+        logger.warning(
+            "Device-staged snapshot does not fit in device memory; "
+            "falling back to host staging."
+        )
+        return False
+    clone_by_key = dict(zip(order, clones))
     for stager, key in rebinds:
-        stager._data = cache[key]
+        stager._data = clone_by_key[key]
         stager._owns_data = True
     return True
 
