@@ -23,7 +23,9 @@ from torchsnapshot_tpu.models.transformer import (
     loss_fn,
     shard_params,
 )
+from torchsnapshot_tpu.utils.test_utils import assert_state_dict_eq
 from torchsnapshot_tpu.utils.train_state import PytreeStateful
+from torchsnapshot_tpu.utils.tree import to_state_dict
 
 CONFIG = TransformerConfig(
     vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq_len=16
@@ -77,17 +79,16 @@ def test_transformer_elastic_resume(tmp_path, take_mode):
     # Elastic restore: different mesh shape AND fewer devices.
     mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
     params2 = jax.tree.map(
-        lambda a: jax.device_put(jnp.zeros_like(a), _resharded(a, mesh, mesh2)),
+        lambda a: jax.device_put(jnp.zeros_like(a), _resharded(a, mesh2)),
         params,
     )
-    opt2 = optax.adam(1e-3)
     opt_state2 = jax.tree.map(
         lambda a: (
-            jax.device_put(jnp.zeros_like(a), _resharded(a, mesh, mesh2))
+            jax.device_put(jnp.zeros_like(a), _resharded(a, mesh2))
             if isinstance(a, jax.Array)
             else a
         ),
-        opt2.init(params2),
+        opt.init(params2),
     )
     target = {
         "params": PytreeStateful(params2),
@@ -96,16 +97,43 @@ def test_transformer_elastic_resume(tmp_path, take_mode):
     Snapshot(path).restore(target)
     params2, opt_state2 = target["params"].tree, target["opt"].tree
 
-    # Bit-exact state.
-    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Bit-exact state, structure-checked (params and Adam moments).
+    assert_state_dict_eq(to_state_dict(params), to_state_dict(params2))
+    assert_state_dict_eq(to_state_dict(opt_state), to_state_dict(opt_state2))
 
-    # Bit-exact continued training on the new mesh.
+    # Continued training on the new mesh: reduction order differs across
+    # mesh shapes, so losses match to tight tolerance rather than bitwise.
     _, _, resumed_losses = _steps(params2, opt, opt_state2, mesh2, 2, seed=9)
-    assert resumed_losses == expected_losses
+    np.testing.assert_allclose(resumed_losses, expected_losses, rtol=1e-6)
+
+    # Bit-exact resume guarantee holds on the *same* mesh: restore onto an
+    # identically-sharded template and the continued losses are identical.
+    params_same = jax.tree.map(
+        lambda a: jax.device_put(jnp.zeros_like(a), a.sharding), params
+    )
+    opt_state_same = jax.tree.map(
+        lambda a: (
+            jax.device_put(jnp.zeros_like(a), _resharded(a, mesh))
+            if isinstance(a, jax.Array)
+            else a
+        ),
+        opt_state,
+    )
+    target_same = {
+        "params": PytreeStateful(params_same),
+        "opt": PytreeStateful(opt_state_same, convert=True),
+    }
+    Snapshot(path).restore(target_same)
+    assert_state_dict_eq(
+        to_state_dict(opt_state), to_state_dict(target_same["opt"].tree)
+    )
+    _, _, same_mesh_losses = _steps(
+        target_same["params"].tree, opt, target_same["opt"].tree, mesh, 2, seed=9
+    )
+    assert same_mesh_losses == expected_losses
 
 
-def _resharded(arr, old_mesh, new_mesh):
+def _resharded(arr, new_mesh):
     """Map an array's NamedSharding spec onto a new mesh."""
     sharding = arr.sharding
     if isinstance(sharding, NamedSharding):
