@@ -1,0 +1,308 @@
+"""Interop tests: reading reference-written snapshots + torch adapters.
+
+The strongest parity evidence available: the *actual reference library*
+(imported from /root/reference, which is mounted read-only) writes a
+snapshot, and this framework reads/restores/converts it. Gated on the
+reference (and torch) being importable.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from torchsnapshot_tpu import Snapshot
+from torchsnapshot_tpu.interop import (
+    ReferenceSnapshotReader,
+    TorchStateful,
+    numpy_to_torch_tree,
+    torch_to_numpy_tree,
+)
+from torchsnapshot_tpu.utils.train_state import PytreeStateful
+
+
+def _import_reference():
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    try:
+        import torchsnapshot as ref
+
+        return ref
+    except Exception:
+        return None
+
+
+@pytest.fixture(scope="module")
+def ref():
+    ref = _import_reference()
+    if ref is None:
+        pytest.skip("reference torchsnapshot not importable")
+    return ref
+
+
+@pytest.fixture()
+def ref_snapshot(ref, tmp_path):
+    """A genuine reference-written snapshot of a model + progress state."""
+    torch.manual_seed(7)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(8, 4), torch.nn.ReLU(), torch.nn.Linear(4, 2)
+    )
+    progress = ref.StateDict(epoch=3, steps=[1, 2, 3], name="run-a")
+    path = str(tmp_path / "ref_snap")
+    ref.Snapshot.take(path=path, app_state={"model": model, "progress": progress})
+    return path, model, progress
+
+
+def test_read_leaf_bitwise(ref_snapshot):
+    path, model, _ = ref_snapshot
+    reader = ReferenceSnapshotReader(path)
+    got = reader.read("model/0.weight")
+    want = model.state_dict()["0.weight"].numpy()
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got, want)
+
+
+def test_load_subtree_and_objects(ref_snapshot):
+    path, model, progress = ref_snapshot
+    reader = ReferenceSnapshotReader(path)
+    tree = reader.load("progress")
+    assert tree["epoch"] == 3
+    assert tree["steps"] == [1, 2, 3]
+    assert tree["name"] == "run-a"
+    model_tree = reader.load("model")
+    for key, tensor in model.state_dict().items():
+        np.testing.assert_array_equal(model_tree[key], tensor.numpy())
+
+
+def test_restore_into_jax_templates(ref_snapshot):
+    path, model, _ = ref_snapshot
+    reader = ReferenceSnapshotReader(path)
+    template = {
+        key: jnp.zeros(tuple(t.shape), dtype=jnp.float32)
+        for key, t in model.state_dict().items()
+    }
+    holder = PytreeStateful(template)
+    reader.restore({"model": holder})
+    for key, tensor in model.state_dict().items():
+        got = np.asarray(holder.tree[key])
+        np.testing.assert_array_equal(got, tensor.numpy())
+        assert isinstance(holder.tree[key], jax.Array)
+
+
+def test_restore_dtype_mismatch_raises(ref_snapshot):
+    path, model, _ = ref_snapshot
+    reader = ReferenceSnapshotReader(path)
+    template = {
+        key: jnp.zeros(tuple(t.shape), dtype=jnp.bfloat16)
+        for key, t in model.state_dict().items()
+    }
+    with pytest.raises(RuntimeError, match="dtype mismatch"):
+        reader.restore({"model": PytreeStateful(template)})
+
+
+def test_convert_to_native_format(ref_snapshot, tmp_path):
+    path, model, _ = ref_snapshot
+    reader = ReferenceSnapshotReader(path)
+    native = reader.convert(str(tmp_path / "native"))
+    # The converted snapshot restores through the native path.
+    template = {
+        key: np.zeros(tuple(t.shape), dtype=np.float32)
+        for key, t in model.state_dict().items()
+    }
+    holder = PytreeStateful(template)
+    native.restore({"model": holder})
+    for key, tensor in model.state_dict().items():
+        np.testing.assert_array_equal(holder.tree[key], tensor.numpy())
+    # Objects survive conversion too.
+    progress = Snapshot(str(tmp_path / "native")).read_object("progress/epoch")
+    assert progress == 3
+
+
+def test_bfloat16_reference_roundtrip(ref, tmp_path):
+    class Holder:
+        def __init__(self):
+            self.t = torch.arange(16, dtype=torch.float32).view(4, 4).bfloat16()
+
+        def state_dict(self):
+            return {"t": self.t}
+
+        def load_state_dict(self, sd):
+            self.t = sd["t"]
+
+    path = str(tmp_path / "bf16")
+    ref.Snapshot.take(path=path, app_state={"h": Holder()})
+    got = ReferenceSnapshotReader(path).read("h/t")
+    import ml_dtypes
+
+    assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+    want = Holder().t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(got.view(np.int16), want.view(np.int16))
+
+
+def test_sharded_tensor_reassembly(tmp_path):
+    """Hand-crafted 2-rank reference manifest with a sharded tensor: the
+    reader merges shards across ranks and reassembles the dense array.
+    (Creating a real ShardedTensor needs torch.distributed init; the
+    format is exercised directly instead — schema per reference
+    manifest.py:49-63.)"""
+    import io as _io
+
+    import yaml
+
+    full = np.arange(32, dtype=np.float32).reshape(8, 4)
+    root = tmp_path / "sharded_snap"
+    shards = []
+    for rank, row0 in enumerate((0, 4)):
+        loc = f"sharded/emb/t_{row0}_0"
+        (root / "sharded" / "emb").mkdir(parents=True, exist_ok=True)
+        buf = _io.BytesIO()
+        torch.save(torch.from_numpy(full[row0 : row0 + 4]), buf)
+        (root / loc).write_bytes(buf.getvalue())
+        shards.append(
+            {
+                "offsets": [row0, 0],
+                "sizes": [4, 4],
+                "tensor": {
+                    "type": "Tensor",
+                    "location": loc,
+                    "serializer": "torch_save",
+                    "dtype": "torch.float32",
+                    "shape": [4, 4],
+                    "replicated": False,
+                },
+            }
+        )
+    manifest = {
+        f"{rank}/emb/t": {"type": "ShardedTensor", "shards": [shard]}
+        for rank, shard in enumerate(shards)
+    }
+    (root / ".snapshot_metadata").write_text(
+        yaml.dump({"version": "0.0.3", "world_size": 2, "manifest": manifest})
+    )
+    reader = ReferenceSnapshotReader(str(root))
+    got = reader.read("emb/t", rank=1)  # any rank sees the merged shards
+    np.testing.assert_array_equal(got, full)
+
+
+def test_convert_refuses_foreign_per_rank(tmp_path):
+    import io as _io
+
+    import yaml
+
+    root = tmp_path / "two_rank"
+    for rank in range(2):
+        (root / str(rank) / "s").mkdir(parents=True, exist_ok=True)
+        buf = _io.BytesIO()
+        torch.save(torch.tensor([rank]), buf)
+        (root / str(rank) / "s" / "v").write_bytes(buf.getvalue())
+    manifest = {
+        f"{rank}/s/v": {
+            "type": "Tensor",
+            "location": f"{rank}/s/v",
+            "serializer": "torch_save",
+            "dtype": "torch.int64",
+            "shape": [1],
+            "replicated": False,
+        }
+        for rank in range(2)
+    }
+    (root / ".snapshot_metadata").write_text(
+        yaml.dump({"version": "0.0.3", "world_size": 2, "manifest": manifest})
+    )
+    with pytest.raises(RuntimeError, match="per-rank"):
+        ReferenceSnapshotReader(str(root)).convert(str(tmp_path / "out"))
+
+
+def test_torch_stateful_roundtrip(tmp_path):
+    torch.manual_seed(11)
+    model = torch.nn.Linear(6, 3)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss = model(torch.randn(2, 6)).sum()
+    loss.backward()
+    opt.step()
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(
+        path, {"model": TorchStateful(model), "opt": TorchStateful(opt)}
+    )
+
+    model2 = torch.nn.Linear(6, 3)
+    opt2 = torch.optim.Adam(model2.parameters(), lr=1e-3)
+    # Adam state must exist before load_state_dict can fill it in place.
+    model2(torch.randn(2, 6)).sum().backward()
+    opt2.step()
+    Snapshot(path).restore(
+        {"model": TorchStateful(model2), "opt": TorchStateful(opt2)}
+    )
+
+    for (k1, t1), (k2, t2) in zip(
+        model.state_dict().items(), model2.state_dict().items()
+    ):
+        assert k1 == k2
+        np.testing.assert_array_equal(t1.numpy(), t2.numpy())
+    s1, s2 = opt.state_dict()["state"], opt2.state_dict()["state"]
+    assert set(s1.keys()) == set(s2.keys())
+    for idx in s1:
+        for field in s1[idx]:
+            v1, v2 = s1[idx][field], s2[idx][field]
+            if isinstance(v1, torch.Tensor):
+                np.testing.assert_array_equal(v1.numpy(), v2.numpy())
+            else:
+                assert v1 == v2
+
+
+def test_torch_stateful_cross_framework(tmp_path):
+    """State saved from a torch module restores into a JAX template."""
+    torch.manual_seed(3)
+    model = torch.nn.Linear(5, 2)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"model": TorchStateful(model)})
+
+    template = {
+        "weight": jnp.zeros((2, 5), jnp.float32),
+        "bias": jnp.zeros((2,), jnp.float32),
+    }
+    holder = PytreeStateful(template)
+    Snapshot(path).restore({"model": holder})
+    np.testing.assert_array_equal(
+        np.asarray(holder.tree["weight"]), model.weight.detach().numpy()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(holder.tree["bias"]), model.bias.detach().numpy()
+    )
+
+
+def test_torch_restore_dtype_mismatch_raises(tmp_path):
+    """Tensor.copy_ would silently cast; the adapter must refuse instead."""
+    model = torch.nn.Linear(4, 2)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"model": TorchStateful(model)})
+    model_bf16 = torch.nn.Linear(4, 2).bfloat16()
+    with pytest.raises(RuntimeError, match="dtype mismatch"):
+        Snapshot(path).restore({"model": TorchStateful(model_bf16)})
+
+
+def test_numpy_never_leaks_through_conversion():
+    """Arrays convert to tensors even where the template has no tensor."""
+    tree = numpy_to_torch_tree(
+        {"a": np.ones((2,), np.float32)}, template={"a": 5}
+    )
+    assert isinstance(tree["a"], torch.Tensor)
+
+
+def test_bf16_tree_conversion_bitwise():
+    import ml_dtypes
+
+    t = torch.arange(7, dtype=torch.float32).bfloat16()
+    tree = torch_to_numpy_tree({"a": t, "b": [t, 5], "c": "x"})
+    assert tree["a"].dtype == np.dtype(ml_dtypes.bfloat16)
+    back = numpy_to_torch_tree(tree)
+    assert back["a"].dtype == torch.bfloat16
+    assert torch.equal(back["a"], t)
+    assert torch.equal(back["b"][0], t)
+    assert back["b"][1] == 5 and back["c"] == "x"
