@@ -51,9 +51,10 @@ def main() -> None:
 
     app_state = {"model": model}
     try:
-        # Warm-up on a small state to exclude one-time costs (imports,
-        # thread pools, first D2H) from the measured run.
-        warm = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        # Warm-up on one representative parameter to exclude one-time
+        # costs (imports, thread pools, XLA compiles of the chunked-
+        # transfer slice kernels, first D2H) from the measured run.
+        warm = SyntheticModel(n_params=1, param_bytes=param_bytes)
         Snapshot.take(f"{bench_dir}/warmup", {"model": warm})
 
         # Flush dirty pages so the measured run isn't throttled by a
@@ -72,6 +73,16 @@ def main() -> None:
         gbps = nbytes / (1024**3) / elapsed
 
         # Secondary numbers for humans (stderr; driver parses stdout only).
+        # Async stall is measured before restore: restore's H2D transfers
+        # keep draining through the device link after it returns, and any
+        # subsequent device op (the consistent-cut clone) would wait on
+        # that queue — training code would never take a snapshot mid-
+        # restore, so that wait is not part of the stall.
+        async_begin = time.monotonic()
+        pending = Snapshot.async_take(f"{bench_dir}/snap-async", app_state)
+        async_stall = time.monotonic() - async_begin
+        pending.wait()
+
         restore_begin = time.monotonic()
         target = SyntheticModel(n_params=1, param_bytes=1 << 20)
         target.params = {
@@ -79,11 +90,6 @@ def main() -> None:
         }
         Snapshot(f"{bench_dir}/snap").restore({"model": target})
         restore_elapsed = time.monotonic() - restore_begin
-
-        async_begin = time.monotonic()
-        pending = Snapshot.async_take(f"{bench_dir}/snap-async", app_state)
-        async_stall = time.monotonic() - async_begin
-        pending.wait()
 
         print(
             f"[bench] {nbytes / 1024**3:.2f} GiB, take {elapsed:.2f}s "
