@@ -13,6 +13,13 @@ Run (single host, N processes):
 Each worker process coordinates through a FileStore; on a real multi-host
 pod, run one process per host with jax.distributed initialized instead and
 drop --nprocs.
+
+Aggregate throughput scales with the number of *independent storage
+channels*: on a parallel filesystem or object store (the reference used
+FSx Lustre; on TPU VMs use ``--url gs://bucket/path``) striping scales
+~linearly, while N processes sharing one local disk split a fixed disk
+bandwidth and show little speedup. ``--url memory://bench`` removes the
+storage bound to show the staging/serialization-path scaling alone.
 """
 
 import argparse
@@ -23,12 +30,14 @@ import shutil
 import sys
 import tempfile
 import time
+from typing import Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, REPO_ROOT)
 
 
 def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
+    # snap_path may be any storage URL (fs path, memory://..., gs://...).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
@@ -57,11 +66,17 @@ def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
         out_queue.put((elapsed, model.total_bytes()))
 
 
-def run(nprocs: int, total_bytes: int, base_dir: str) -> dict:
+def run(
+    nprocs: int, total_bytes: int, base_dir: str, url: Optional[str] = None
+) -> dict:
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     store = os.path.join(base_dir, f"store-{nprocs}")
-    snap = os.path.join(base_dir, f"snap-{nprocs}")
+    snap = (
+        f"{url.rstrip('/')}/snap-{nprocs}"
+        if url
+        else os.path.join(base_dir, f"snap-{nprocs}")
+    )
     procs = [
         ctx.Process(
             target=_worker, args=(r, nprocs, store, snap, total_bytes, q)
@@ -88,13 +103,19 @@ def main() -> None:
     parser.add_argument("--nprocs", type=int, default=4)
     parser.add_argument("--total-bytes", type=int, default=2 * 1024**3)
     parser.add_argument("--work-dir", default=None)
+    parser.add_argument(
+        "--url",
+        default=None,
+        help="storage URL prefix (e.g. gs://bucket/bench, memory://bench); "
+        "default: a directory under --work-dir",
+    )
     args = parser.parse_args()
 
     base_dir = args.work_dir or tempfile.mkdtemp(prefix="tpusnapshot-ddp-")
     try:
         results = []
         for n in (1, args.nprocs):
-            res = run(n, args.total_bytes, base_dir)
+            res = run(n, args.total_bytes, base_dir, url=args.url)
             results.append(res)
             print(json.dumps(res), file=sys.stderr)
         speedup = results[-1]["GBps"] / max(results[0]["GBps"], 1e-9)
