@@ -130,6 +130,15 @@ def main() -> None:
             )
         )
     finally:
+        if args.url:
+            # Remote snapshots aren't under base_dir; GC them explicitly.
+            from torchsnapshot_tpu import Snapshot
+
+            for n in (1, args.nprocs):
+                try:
+                    Snapshot(f"{args.url.rstrip('/')}/snap-{n}").delete()
+                except Exception:
+                    pass
         if args.work_dir is None:
             shutil.rmtree(base_dir, ignore_errors=True)
 

@@ -1,0 +1,187 @@
+"""Storage retries, span tracing, and snapshot deletion (beyond reference
+parity — the reference has no retries, no tracing, and no snapshot GC,
+SURVEY §5)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict, tracing
+from torchsnapshot_tpu.io_types import (
+    IOReq,
+    RetryingStoragePlugin,
+    retry_storage_op,
+)
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+class FlakyStorage(MemoryStoragePlugin):
+    """Fails the first ``fail_n`` write and read attempts."""
+
+    def __init__(self, fail_n: int = 2) -> None:
+        super().__init__()
+        self.write_attempts = 0
+        self.read_attempts = 0
+        self._fail_n = fail_n
+
+    async def write(self, io_req: IOReq) -> None:
+        self.write_attempts += 1
+        if self.write_attempts <= self._fail_n:
+            raise ConnectionResetError("transient write failure")
+        await super().write(io_req)
+
+    async def read(self, io_req: IOReq) -> None:
+        self.read_attempts += 1
+        if self.read_attempts <= self._fail_n:
+            # Simulate a partial read then failure.
+            io_req.buf.write(b"garbage")
+            raise TimeoutError("transient read failure")
+        await super().read(io_req)
+
+
+def test_retry_recovers_transient_write_and_read(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "3")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    from torchsnapshot_tpu.scheduler import execute_read_reqs, execute_write_reqs
+    from torchsnapshot_tpu.io_preparer import prepare_read, prepare_write
+
+    inner = FlakyStorage(fail_n=2)
+    storage = RetryingStoragePlugin(inner)
+    data = np.arange(64, dtype=np.float32)
+    entry, wrs = prepare_write(data, "s/v", rank=0)
+    asyncio.run(execute_write_reqs(wrs, storage, 1 << 30, rank=0))
+    assert inner.write_attempts == 3  # 2 failures + 1 success
+
+    out = {}
+    rrs, fins = prepare_read(entry, None, lambda v: out.update(v=v))
+    asyncio.run(execute_read_reqs(rrs, storage, 1 << 30, rank=0))
+    for f in fins:
+        f()
+    np.testing.assert_array_equal(out["v"], data)
+    assert inner.read_attempts == 3
+
+
+def test_retry_exhaustion_propagates(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "1")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+
+    async def _always_fail():
+        raise ConnectionResetError("down")
+
+    with pytest.raises(ConnectionResetError):
+        asyncio.run(retry_storage_op(_always_fail, "write(x)"))
+
+
+def test_dispatch_wraps_every_backend_with_retry():
+    """All storage traffic (payloads, metadata commit, markers, deletes)
+    goes through url_to_storage_plugin, so wrapping there covers every op."""
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    for url in ("memory://retrytest", "/tmp/retrytest-fs"):
+        plugin = url_to_storage_plugin(url)
+        assert isinstance(plugin, RetryingStoragePlugin)
+        plugin.close()
+
+
+def test_cloud_not_found_not_retried():
+    class FakeGcsNotFound(Exception):
+        pass
+
+    FakeGcsNotFound.__name__ = "NotFound"
+    calls = []
+
+    async def _missing():
+        calls.append(1)
+        raise FakeGcsNotFound("404 object missing")
+
+    with pytest.raises(FakeGcsNotFound):
+        asyncio.run(retry_storage_op(_missing, "read(z)"))
+    assert len(calls) == 1
+
+
+def test_not_found_is_never_retried():
+    calls = []
+
+    async def _missing():
+        calls.append(1)
+        raise FileNotFoundError("no such object")
+
+    with pytest.raises(FileNotFoundError):
+        asyncio.run(retry_storage_op(_missing, "read(y)"))
+    assert len(calls) == 1
+
+
+def test_tracing_records_snapshot_spans(tmp_path):
+    trace_path = str(tmp_path / "trace.json")
+    state = StateDict(w=jnp.arange(16, dtype=jnp.float32))
+    tracing.enable(trace_path)
+    try:
+        path = str(tmp_path / "snap")
+        Snapshot.take(path, {"s": state})
+        target = StateDict(w=jnp.zeros(16, dtype=jnp.float32))
+        Snapshot(path).restore({"s": target})
+    finally:
+        tracing.flush()
+        tracing.disable()
+
+    with open(trace_path) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"Snapshot.take", "Snapshot.restore", "stage", "write", "read",
+            "consume"} <= names
+    for e in events:
+        assert e["dur"] >= 0 if e["ph"] == "X" else True
+
+
+def test_tracing_disabled_is_noop():
+    assert not tracing.enabled()
+    with tracing.span("nothing"):
+        pass  # must not record or raise
+    assert tracing.flush() is None
+
+
+def test_delete_removes_payloads_and_metadata(tmp_path):
+    path = str(tmp_path / "snap")
+    state = StateDict(a=jnp.arange(8, dtype=jnp.float32), b="hello")
+    Snapshot.take(path, {"s": state})
+    assert os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+    snap = Snapshot(path)
+    snap.delete()
+
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+    # Every payload object is gone (only empty directories may remain).
+    leftovers = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(path)
+        for f in fs
+    ]
+    assert leftovers == []
+    with pytest.raises(FileNotFoundError):
+        Snapshot(path).restore({"s": StateDict(a=jnp.zeros(8), b="")})
+
+
+def test_delete_is_idempotent_and_cleans_async_markers(tmp_path):
+    path = str(tmp_path / "snap")
+    state = StateDict(a=jnp.arange(8, dtype=jnp.float32))
+    Snapshot.async_take(path, {"s": state}).wait()
+    completed = os.path.join(path, ".completed")
+    assert os.path.isdir(completed) and any(os.scandir(completed))
+
+    Snapshot(path).delete()
+    leftovers = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert leftovers == []
+    with pytest.raises(FileNotFoundError):
+        Snapshot(path).delete()  # metadata already gone

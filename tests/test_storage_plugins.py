@@ -80,8 +80,14 @@ def test_memory_shared_store():
 
 
 def test_url_dispatch(tmp_path):
-    assert isinstance(url_to_storage_plugin(str(tmp_path)), FSStoragePlugin)
-    assert isinstance(url_to_storage_plugin(f"fs://{tmp_path}"), FSStoragePlugin)
-    assert isinstance(url_to_storage_plugin("memory://x"), MemoryStoragePlugin)
+    # Every resolved plugin is wrapped with the retry decorator; the
+    # backend type is visible on ._inner.
+    assert isinstance(url_to_storage_plugin(str(tmp_path))._inner, FSStoragePlugin)
+    assert isinstance(
+        url_to_storage_plugin(f"fs://{tmp_path}")._inner, FSStoragePlugin
+    )
+    assert isinstance(
+        url_to_storage_plugin("memory://x")._inner, MemoryStoragePlugin
+    )
     with pytest.raises(RuntimeError, match="Unsupported protocol"):
         url_to_storage_plugin("bogus://x")

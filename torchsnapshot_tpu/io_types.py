@@ -14,12 +14,76 @@ TPU-native analog of reference torchsnapshot/io_types.py:15-71.
 """
 
 import abc
+import asyncio
 import io
+import logging
+import os
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
 BufferType = Union[bytes, bytearray, memoryview]
+
+logger = logging.getLogger(__name__)
+
+
+def is_not_found_error(exc: BaseException) -> bool:
+    """Whether a storage failure means "object does not exist".
+
+    fs raises FileNotFoundError, the memory plugin KeyError; cloud client
+    not-found exception classes carry NotFound/NoSuchKey/404 in their
+    name/args. Not-found is deterministic: pollers treat it as "not yet",
+    and the retry layer never retries it.
+    """
+    if isinstance(exc, (FileNotFoundError, KeyError)):
+        return True
+    name = type(exc).__name__
+    if "NotFound" in name or "NoSuchKey" in name:
+        return True
+    text = str(exc)
+    return "404" in text or "NoSuchKey" in text or "Not Found" in text
+
+
+# Storage-op retry policy (beyond reference parity: the reference has no
+# retries anywhere — one transient object-store 5xx aborts the whole
+# snapshot, SURVEY §5). Writes are whole-object puts, reads are (ranged)
+# gets, deletes are idempotent — all safe to retry.
+_STORAGE_RETRIES_ENV_VAR = "TPUSNAPSHOT_STORAGE_RETRIES"
+_DEFAULT_STORAGE_ATTEMPTS = 3
+_RETRY_BACKOFF_INITIAL_S = 0.25
+
+
+def _storage_attempts() -> int:
+    return 1 + max(
+        0,
+        int(
+            os.environ.get(
+                _STORAGE_RETRIES_ENV_VAR, _DEFAULT_STORAGE_ATTEMPTS - 1
+            )
+        ),
+    )
+
+
+async def retry_storage_op(make_coro, desc: str):
+    """Run ``await make_coro()`` with exponential backoff on transient
+    failures. ``make_coro`` is a zero-arg callable returning a fresh
+    coroutine (a coroutine object cannot be awaited twice)."""
+    attempts = _storage_attempts()
+    delay = _RETRY_BACKOFF_INITIAL_S
+    for attempt in range(1, attempts + 1):
+        try:
+            return await make_coro()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if is_not_found_error(e) or attempt == attempts:
+                raise
+            logger.warning(
+                f"Storage op {desc} failed (attempt {attempt}/{attempts}): "
+                f"{e!r}; retrying in {delay:.2f}s"
+            )
+            await asyncio.sleep(delay)
+            delay *= 2
 
 
 class BufferStager(abc.ABC):
@@ -75,6 +139,46 @@ def io_payload(io_req: "IOReq") -> BufferType:
     if io_req.data is not None:
         return io_req.data
     return io_req.buf.getbuffer()
+
+
+class RetryingStoragePlugin:
+    """Decorator adding transparent retries to every op of a plugin.
+
+    Applied by ``url_to_storage_plugin`` so *all* storage traffic —
+    payloads, the metadata commit, async-completion markers, random-access
+    reads, deletes — shares one retry policy. A failed read attempt may
+    have partially filled the request buffer, so reads reset it per
+    attempt. Not-found propagates immediately (see
+    :func:`is_not_found_error`).
+    """
+
+    def __init__(self, inner: "StoragePlugin") -> None:
+        self._inner = inner
+        # Scheduler concurrency caps pass through to the real backend's.
+        self.max_write_concurrency = inner.max_write_concurrency
+        self.max_read_concurrency = inner.max_read_concurrency
+
+    async def write(self, io_req: "IOReq") -> None:
+        await retry_storage_op(
+            lambda: self._inner.write(io_req), f"write({io_req.path})"
+        )
+
+    async def read(self, io_req: "IOReq") -> None:
+        async def _attempt() -> None:
+            io_req.buf.seek(0)
+            io_req.buf.truncate()
+            io_req.data = None
+            await self._inner.read(io_req)
+
+        await retry_storage_op(_attempt, f"read({io_req.path})")
+
+    async def delete(self, path: str) -> None:
+        await retry_storage_op(
+            lambda: self._inner.delete(path), f"delete({path})"
+        )
+
+    def close(self) -> None:
+        self._inner.close()
 
 
 class StoragePlugin(abc.ABC):

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import psutil
 
+from . import tracing
 from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
 
 logger = logging.getLogger(__name__)
@@ -114,9 +115,12 @@ async def execute_write_reqs(
                 if budget >= cost or nothing_in_flight:
                     wr = pending.popleft()
                     budget -= cost
-                    task = asyncio.ensure_future(
-                        wr.buffer_stager.stage_buffer(executor)
-                    )
+
+                    async def _stage(wr=wr, cost=cost):
+                        with tracing.span("stage", path=wr.path, bytes=cost):
+                            return await wr.buffer_stager.stage_buffer(executor)
+
+                    task = asyncio.ensure_future(_stage())
                     staging[task] = (wr, cost)
                 else:
                     break
@@ -124,7 +128,12 @@ async def execute_write_reqs(
             while staged and len(io_tasks) < max_io:
                 wr, buf = staged.popleft()
                 io_req = IOReq(path=wr.path, data=buf)
-                task = asyncio.ensure_future(storage.write(io_req))
+
+                async def _write(io_req=io_req, path=wr.path, n=len(buf)):
+                    with tracing.span("write", path=path, bytes=n):
+                        await storage.write(io_req)
+
+                task = asyncio.ensure_future(_write())
                 io_tasks[task] = len(buf)
 
             in_flight = set(staging) | set(io_tasks)
@@ -180,8 +189,9 @@ async def execute_read_reqs(
                     budget -= cost
                     io_req = IOReq(path=rr.path, byte_range=rr.byte_range)
 
-                    async def _read(io_req=io_req) -> IOReq:
-                        await storage.read(io_req)
+                    async def _read(io_req=io_req, path=rr.path) -> IOReq:
+                        with tracing.span("read", path=path):
+                            await storage.read(io_req)
                         return io_req
 
                     task = asyncio.ensure_future(_read())
@@ -200,9 +210,12 @@ async def execute_read_reqs(
                     rr, cost = reading.pop(task)
                     buf = io_payload(task.result())
                     bytes_read += len(buf)
-                    consume_task = asyncio.ensure_future(
-                        rr.buffer_consumer.consume_buffer(buf, executor)
-                    )
+
+                    async def _consume(rr=rr, buf=buf):
+                        with tracing.span("consume", path=rr.path, bytes=len(buf)):
+                            await rr.buffer_consumer.consume_buffer(buf, executor)
+
+                    consume_task = asyncio.ensure_future(_consume())
                     consuming[consume_task] = cost
                 else:
                     cost = consuming.pop(task)

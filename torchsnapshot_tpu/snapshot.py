@@ -45,10 +45,18 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from . import tracing
 from .coord import Coordinator, get_coordinator
 from .flatten import flatten, inflate
 from .io_preparer import device_clone_write_reqs, prepare_read, prepare_write
-from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
+from .io_types import (
+    IOReq,
+    ReadReq,
+    StoragePlugin,
+    WriteReq,
+    io_payload,
+    is_not_found_error,
+)
 from .manifest import (
     DictEntry,
     Entry,
@@ -111,15 +119,16 @@ class Snapshot:
         path = cls._collate_path(coordinator, path)
         storage = url_to_storage_plugin(path)
         try:
-            cls._take_impl(
-                path=path,
-                app_state=app_state,
-                coordinator=coordinator,
-                storage=storage,
-                replicated=replicated or [],
-                background=None,
-                compression=compression,
-            )
+            with tracing.span("Snapshot.take", path=path):
+                cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    coordinator=coordinator,
+                    storage=storage,
+                    replicated=replicated or [],
+                    background=None,
+                    compression=compression,
+                )
         finally:
             storage.close()
         return cls(path=path, coord=coord)
@@ -354,37 +363,30 @@ class Snapshot:
         rank = coordinator.get_rank()
         storage = url_to_storage_plugin(self.path)
         try:
-            metadata = self._read_snapshot_metadata(storage)
-            available = get_available_entries(metadata.manifest, rank)
+            with tracing.span("Snapshot.restore", path=self.path):
+                return self._restore_impl(
+                    app_state, coordinator, rank, storage, paths
+                )
+        finally:
+            storage.close()
 
-            app_state = dict(app_state)
-            rng_key, rng_stateful = _pop_rng_state(app_state)
+    def _restore_impl(self, app_state, coordinator, rank, storage, paths):
+        # The restore() wrapper owns the storage plugin's lifetime.
+        metadata = self._read_snapshot_metadata(storage)
+        available = get_available_entries(metadata.manifest, rank)
 
-            global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
-            budget = get_process_memory_budget_bytes(coordinator)
-            n_selected = 0
-            for key in global_keys:
-                stateful = app_state.get(key)
-                if stateful is not None:
-                    n_selected += _load_stateful(
-                        key=key,
-                        stateful=stateful,
-                        available=available,
-                        storage=storage,
-                        budget=budget,
-                        rank=rank,
-                        world_size=coordinator.get_world_size(),
-                        snapshot_world_size=metadata.world_size,
-                        path_globs=paths,
-                    )
-                coordinator.barrier()
+        app_state = dict(app_state)
+        rng_key, rng_stateful = _pop_rng_state(app_state)
 
-            # RNG state is restored last so that no other stateful's
-            # load_state_dict() perturbs it (reference snapshot.py:258-268).
-            if rng_stateful is not None:
+        global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
+        budget = get_process_memory_budget_bytes(coordinator)
+        n_selected = 0
+        for key in global_keys:
+            stateful = app_state.get(key)
+            if stateful is not None:
                 n_selected += _load_stateful(
-                    key=rng_key,
-                    stateful=rng_stateful,
+                    key=key,
+                    stateful=stateful,
                     available=available,
                     storage=storage,
                     budget=budget,
@@ -393,17 +395,79 @@ class Snapshot:
                     snapshot_world_size=metadata.world_size,
                     path_globs=paths,
                 )
-            if paths is not None and n_selected == 0:
-                # A filter that matches nothing is almost certainly a typo
-                # (wrong case, stale key); a silent no-op would let training
-                # "resume" from fresh weights. All collectives above already
-                # completed, so raising here cannot desynchronize ranks.
-                raise RuntimeError(
-                    f"restore(paths={paths!r}) matched no leaf in the "
-                    f"app_state. Leaves are named "
-                    f'"<stateful_key>/<flattened/path>", e.g. '
-                    f'"model/params/w"; see get_manifest().'
+            coordinator.barrier()
+
+        # RNG state is restored last so that no other stateful's
+        # load_state_dict() perturbs it (reference snapshot.py:258-268).
+        if rng_stateful is not None:
+            n_selected += _load_stateful(
+                key=rng_key,
+                stateful=rng_stateful,
+                available=available,
+                storage=storage,
+                budget=budget,
+                rank=rank,
+                world_size=coordinator.get_world_size(),
+                snapshot_world_size=metadata.world_size,
+                path_globs=paths,
+            )
+        if paths is not None and n_selected == 0:
+            # A filter that matches nothing is almost certainly a typo
+            # (wrong case, stale key); a silent no-op would let training
+            # "resume" from fresh weights. All collectives above already
+            # completed, so raising here cannot desynchronize ranks.
+            raise RuntimeError(
+                f"restore(paths={paths!r}) matched no leaf in the "
+                f"app_state. Leaves are named "
+                f'"<stateful_key>/<flattened/path>", e.g. '
+                f'"model/params/w"; see get_manifest().'
+            )
+
+    def delete(self) -> None:
+        """Delete this snapshot from storage (beyond reference parity —
+        the reference leaves snapshot GC entirely to the user).
+
+        Ordering is uncommit-then-collect: the metadata document (the
+        commit point) is removed *first*, so an interrupted delete leaves
+        an unreadable snapshot rather than a readable one with missing
+        payloads; then every manifest-referenced payload object and the
+        async-commit markers are removed. Not-found objects are skipped
+        (delete is idempotent). Single-process operation — run it from
+        one rank or an offline tool.
+        """
+        storage = url_to_storage_plugin(self.path)
+        try:
+            metadata = self._read_snapshot_metadata(storage)
+            locations: Set[str] = set()
+            for entry in metadata.manifest.values():
+                if isinstance(entry, ShardedArrayEntry):
+                    for shard in entry.shards:
+                        locations.add(shard.array.location)
+                else:
+                    location = getattr(entry, "location", None)
+                    if location:
+                        locations.add(location)
+            markers = [
+                f".completed/{metadata.take_id}/{r}"
+                for r in range(metadata.world_size)
+                if metadata.take_id
+            ]
+
+            async def _delete_all() -> None:
+                # Uncommit first; then payload deletes are order-
+                # independent — fan out up to the backend's write cap.
+                await _delete_ignore_missing(storage, SNAPSHOT_METADATA_FNAME)
+                sem = asyncio.Semaphore(max(1, storage.max_write_concurrency))
+
+                async def _one(loc: str) -> None:
+                    async with sem:
+                        await _delete_ignore_missing(storage, loc)
+
+                await asyncio.gather(
+                    *(_one(loc) for loc in sorted(locations) + markers)
                 )
+
+            asyncio.run(_delete_all())
         finally:
             storage.close()
 
@@ -730,21 +794,16 @@ def _save_stateful(
 _COMPLETION_TIMEOUT_S = 1800.0
 
 
-def _is_not_found_error(exc: BaseException) -> bool:
-    """Whether a storage read failure means "object does not exist (yet)".
+async def _delete_ignore_missing(storage: StoragePlugin, path: str) -> None:
+    try:
+        await storage.delete(path)
+    except Exception as e:
+        if not _is_not_found_error(e):
+            raise
 
-    fs raises FileNotFoundError, the memory plugin KeyError; cloud client
-    not-found exception classes carry NotFound/NoSuchKey/404 in their
-    name/args. Anything else (auth, network teardown, closed client) is a
-    real error and must propagate instead of being polled into a timeout.
-    """
-    if isinstance(exc, (FileNotFoundError, KeyError)):
-        return True
-    name = type(exc).__name__
-    if "NotFound" in name or "NoSuchKey" in name:
-        return True
-    text = str(exc)
-    return "404" in text or "NoSuchKey" in text or "Not Found" in text
+
+# Canonical classifier lives in io_types (shared with the retry layer).
+_is_not_found_error = is_not_found_error
 
 
 async def _collect_completion_manifests(

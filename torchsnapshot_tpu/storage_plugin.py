@@ -10,7 +10,7 @@ entry-point group so third-party backends can register themselves
 from importlib import metadata as importlib_metadata
 from typing import Dict, Optional
 
-from .io_types import StoragePlugin
+from .io_types import RetryingStoragePlugin, StoragePlugin
 from .storage_plugins.fs import FSStoragePlugin
 from .storage_plugins.memory import MemoryStoragePlugin
 
@@ -21,6 +21,13 @@ _MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
 
 
 def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    """Resolve a URL to its backend, wrapped with the retry policy (every
+    storage op — payloads, metadata commit, markers, deletes — retries
+    transient failures; see io_types.retry_storage_op)."""
+    return RetryingStoragePlugin(_resolve_plugin(url_path))
+
+
+def _resolve_plugin(url_path: str) -> StoragePlugin:
     if "://" in url_path:
         protocol, path = url_path.split("://", 1)
         if protocol == "":
