@@ -61,6 +61,49 @@ def test_bfloat16_bit_exact():
     )
 
 
+@pytest.mark.parametrize(
+    "dtype",
+    [
+        "float16",
+        "float32",
+        "float64",
+        "bfloat16",
+        "float8_e4m3fn",
+        "float8_e5m2",
+        "int8",
+        "int16",
+        "int32",
+        "int64",
+        "uint8",
+        "uint32",
+        "bool_",
+        "complex64",
+    ],
+)
+def test_dtype_matrix_bit_exact(dtype):
+    """Raw-payload serialization must round-trip every dtype a training
+    program can hold bit-exactly (SURVEY §7 hard part #4) — including the
+    ml_dtypes families (bfloat16/float8) that lack the buffer protocol."""
+    jdt = getattr(jnp, dtype)
+    rng = np.random.RandomState(0)
+    if dtype == "bool_":
+        arr = jnp.asarray(rng.rand(9, 5) > 0.5)
+    elif dtype == "complex64":
+        arr = jnp.asarray((rng.randn(9, 5) + 1j * rng.randn(9, 5)).astype(np.complex64))
+    elif dtype.startswith(("int", "uint")):
+        arr = jnp.asarray(rng.randint(0, 100, (9, 5)), dtype=jdt)
+    else:
+        arr = jnp.asarray(rng.randn(9, 5), dtype=jdt)
+    entry, restored, _ = _save_and_load(arr, arr)
+    assert restored.dtype == arr.dtype
+    a = np.asarray(restored)
+    b = np.asarray(arr)
+    # Compare raw bytes, not values: NaNs and negative zeros must survive.
+    np.testing.assert_array_equal(
+        a.view(np.uint8).reshape(-1), b.view(np.uint8).reshape(-1)
+    )
+
+
 def test_scalar_array_round_trip():
     arr = jnp.asarray(3.5)
     entry, restored, _ = _save_and_load(arr, arr)

@@ -80,14 +80,17 @@ def test_memory_shared_store():
 
 
 def test_url_dispatch(tmp_path):
-    # Every resolved plugin is wrapped with the retry decorator; the
-    # backend type is visible on ._inner.
-    assert isinstance(url_to_storage_plugin(str(tmp_path))._inner, FSStoragePlugin)
-    assert isinstance(
-        url_to_storage_plugin(f"fs://{tmp_path}")._inner, FSStoragePlugin
-    )
-    assert isinstance(
-        url_to_storage_plugin("memory://x")._inner, MemoryStoragePlugin
-    )
+    # Every resolved plugin is a StoragePlugin wrapped with the retry
+    # decorator; the backend type is visible on ._inner.
+    from torchsnapshot_tpu.io_types import StoragePlugin
+
+    for url, backend_cls in (
+        (str(tmp_path), FSStoragePlugin),
+        (f"fs://{tmp_path}", FSStoragePlugin),
+        ("memory://x", MemoryStoragePlugin),
+    ):
+        plugin = url_to_storage_plugin(url)
+        assert isinstance(plugin, StoragePlugin)
+        assert isinstance(plugin._inner, backend_cls)
     with pytest.raises(RuntimeError, match="Unsupported protocol"):
         url_to_storage_plugin("bogus://x")

@@ -30,12 +30,13 @@ logger = logging.getLogger(__name__)
 def is_not_found_error(exc: BaseException) -> bool:
     """Whether a storage failure means "object does not exist".
 
-    fs raises FileNotFoundError, the memory plugin KeyError; cloud client
-    not-found exception classes carry NotFound/NoSuchKey/404 in their
-    name/args. Not-found is deterministic: pollers treat it as "not yet",
-    and the retry layer never retries it.
+    fs and memory plugins raise FileNotFoundError; cloud client not-found
+    exception classes carry NotFound/NoSuchKey/404 in their name/args.
+    Not-found is deterministic: pollers treat it as "not yet", and the
+    retry layer never retries it. Deliberately narrow — a stray KeyError
+    from a plugin's internals is a bug to surface, not a missing object.
     """
-    if isinstance(exc, (FileNotFoundError, KeyError)):
+    if isinstance(exc, FileNotFoundError):
         return True
     name = type(exc).__name__
     if "NotFound" in name or "NoSuchKey" in name:
@@ -141,46 +142,6 @@ def io_payload(io_req: "IOReq") -> BufferType:
     return io_req.buf.getbuffer()
 
 
-class RetryingStoragePlugin:
-    """Decorator adding transparent retries to every op of a plugin.
-
-    Applied by ``url_to_storage_plugin`` so *all* storage traffic —
-    payloads, the metadata commit, async-completion markers, random-access
-    reads, deletes — shares one retry policy. A failed read attempt may
-    have partially filled the request buffer, so reads reset it per
-    attempt. Not-found propagates immediately (see
-    :func:`is_not_found_error`).
-    """
-
-    def __init__(self, inner: "StoragePlugin") -> None:
-        self._inner = inner
-        # Scheduler concurrency caps pass through to the real backend's.
-        self.max_write_concurrency = inner.max_write_concurrency
-        self.max_read_concurrency = inner.max_read_concurrency
-
-    async def write(self, io_req: "IOReq") -> None:
-        await retry_storage_op(
-            lambda: self._inner.write(io_req), f"write({io_req.path})"
-        )
-
-    async def read(self, io_req: "IOReq") -> None:
-        async def _attempt() -> None:
-            io_req.buf.seek(0)
-            io_req.buf.truncate()
-            io_req.data = None
-            await self._inner.read(io_req)
-
-        await retry_storage_op(_attempt, f"read({io_req.path})")
-
-    async def delete(self, path: str) -> None:
-        await retry_storage_op(
-            lambda: self._inner.delete(path), f"delete({path})"
-        )
-
-    def close(self) -> None:
-        self._inner.close()
-
-
 class StoragePlugin(abc.ABC):
     # How many concurrent IO ops this backend profits from, read by the
     # scheduler as its per-pipeline concurrency caps. Object stores
@@ -205,3 +166,43 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     def close(self) -> None:
         ...
+
+
+class RetryingStoragePlugin(StoragePlugin):
+    """Decorator adding transparent retries to every op of a plugin.
+
+    Applied by ``url_to_storage_plugin`` so *all* storage traffic —
+    payloads, the metadata commit, async-completion markers, random-access
+    reads, deletes — shares one retry policy. A failed read attempt may
+    have partially filled the request buffer, so reads reset it per
+    attempt. Not-found propagates immediately (see
+    :func:`is_not_found_error`).
+    """
+
+    def __init__(self, inner: StoragePlugin) -> None:
+        self._inner = inner
+        # Scheduler concurrency caps pass through to the real backend's.
+        self.max_write_concurrency = inner.max_write_concurrency
+        self.max_read_concurrency = inner.max_read_concurrency
+
+    async def write(self, io_req: IOReq) -> None:
+        await retry_storage_op(
+            lambda: self._inner.write(io_req), f"write({io_req.path})"
+        )
+
+    async def read(self, io_req: IOReq) -> None:
+        async def _attempt() -> None:
+            io_req.buf.seek(0)
+            io_req.buf.truncate()
+            io_req.data = None
+            await self._inner.read(io_req)
+
+        await retry_storage_op(_attempt, f"read({io_req.path})")
+
+    async def delete(self, path: str) -> None:
+        await retry_storage_op(
+            lambda: self._inner.delete(path), f"delete({path})"
+        )
+
+    def close(self) -> None:
+        self._inner.close()

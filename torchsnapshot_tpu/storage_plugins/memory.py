@@ -24,7 +24,12 @@ class MemoryStoragePlugin(StoragePlugin):
 
     async def read(self, io_req: IOReq) -> None:
         async with self._lock:
-            data = self.store[io_req.path]
+            try:
+                data = self.store[io_req.path]
+            except KeyError:
+                # Speak the same not-found dialect as the fs plugin so the
+                # not-found classifier needs no backend-specific cases.
+                raise FileNotFoundError(io_req.path) from None
         if io_req.byte_range is not None:
             start, end = io_req.byte_range
             data = data[start:end]
@@ -32,6 +37,8 @@ class MemoryStoragePlugin(StoragePlugin):
 
     async def delete(self, path: str) -> None:
         async with self._lock:
+            if path not in self.store:
+                raise FileNotFoundError(path)
             del self.store[path]
 
     def close(self) -> None:

@@ -18,11 +18,18 @@ or programmatically::
     ... Snapshot.take(...) ...
     tracing.flush()
 
-Spans nest naturally per thread (Chrome trace "B"/"E" events carry
-tid), so scheduler thread-pool staging shows up as parallel lanes.
+Spans are recorded as Chrome-trace *async* events ("b"/"e" with a unique
+id): the scheduler runs many stage/write/read spans concurrently on one
+event-loop thread, and async events render each span on its own lane
+where same-track duration events would overlap and garble the timeline.
+
+Multi-process runs: each process writes its own file — the env path gets
+a ``.pid<N>`` suffix (or substitute ``{pid}`` in the path yourself);
+``enable(path)`` writes exactly ``path``.
 """
 
 import atexit
+import itertools
 import json
 import os
 import threading
@@ -36,6 +43,7 @@ _lock = threading.Lock()
 _events: Optional[List[Dict[str, Any]]] = None
 _path: Optional[str] = None
 _t0: float = 0.0
+_span_ids = itertools.count(1)
 
 
 def enable(path: str) -> None:
@@ -72,31 +80,49 @@ def flush() -> Optional[str]:
 
 @contextmanager
 def span(name: str, **args: Any):
-    """Time a region. ``args`` (small JSON-able values) land in the event."""
+    """Time a region. ``args`` (small JSON-able values) land in the event.
+
+    Emitted as an async begin/end pair with a unique id, so arbitrarily
+    overlapping spans (concurrent scheduler IO on one event-loop thread)
+    stay well-formed.
+    """
     if _events is None:
         yield
         return
     tid = threading.get_ident() & 0xFFFFFFFF
     pid = os.getpid()
-    begin_us = (time.monotonic() - _t0) * 1e6
+    span_id = next(_span_ids)
+    begin = {
+        "name": name,
+        "cat": "snapshot",
+        "ph": "b",
+        "id": span_id,
+        "ts": (time.monotonic() - _t0) * 1e6,
+        "pid": pid,
+        "tid": tid,
+    }
+    if args:
+        begin["args"] = args
+    evs = _events
+    if evs is not None:
+        with _lock:
+            evs.append(begin)
     try:
         yield
     finally:
-        end_us = (time.monotonic() - _t0) * 1e6
-        ev = {
+        end = {
             "name": name,
-            "ph": "X",  # complete event: begin + duration in one record
-            "ts": begin_us,
-            "dur": end_us - begin_us,
+            "cat": "snapshot",
+            "ph": "e",
+            "id": span_id,
+            "ts": (time.monotonic() - _t0) * 1e6,
             "pid": pid,
-            "tid": tid,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
         }
-        if args:
-            ev["args"] = args
         evs = _events
         if evs is not None:
             with _lock:
-                evs.append(ev)
+                evs.append(end)
 
 
 def instant(name: str, **args: Any) -> None:
@@ -121,9 +147,17 @@ def instant(name: str, **args: Any) -> None:
 
 def _maybe_enable_from_env() -> None:
     path = os.environ.get(_TRACE_ENV_VAR)
-    if path:
-        enable(path)
-        atexit.register(flush)
+    if not path:
+        return
+    # One file per process: concurrent ranks/workers sharing the env var
+    # must not clobber each other's trace on flush.
+    if "{pid}" in path:
+        path = path.format(pid=os.getpid())
+    else:
+        root, ext = os.path.splitext(path)
+        path = f"{root}.pid{os.getpid()}{ext or '.json'}"
+    enable(path)
+    atexit.register(flush)
 
 
 _maybe_enable_from_env()
