@@ -198,3 +198,34 @@ def test_async_take_background_write_failure_surfaces(tmp_path, monkeypatch):
     with pytest.raises(IOError, match="disk on fire"):
         pending.wait()
     assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")
+
+
+def test_concurrent_async_takes_to_distinct_paths(tmp_path):
+    """Two in-flight async snapshots (e.g. overlapping checkpoint
+    cadences) must drain independently and both commit correctly."""
+    a = {"w": jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)}
+    b = {"w": jnp.arange(4096, dtype=jnp.float32).reshape(64, 64) * 2}
+    pa = Snapshot.async_take(str(tmp_path / "a"), {"m": _Holder(a)})
+    pb = Snapshot.async_take(str(tmp_path / "b"), {"m": _Holder(b)})
+    sa, sb = pa.wait(), pb.wait()
+
+    ta = {"m": _Holder({"w": jnp.zeros((64, 64), jnp.float32)})}
+    tb = {"m": _Holder({"w": jnp.zeros((64, 64), jnp.float32)})}
+    sa.restore(ta)
+    sb.restore(tb)
+    np.testing.assert_array_equal(np.asarray(ta["m"].sd["w"]), np.asarray(a["w"]))
+    np.testing.assert_array_equal(np.asarray(tb["m"].sd["w"]), np.asarray(b["w"]))
+
+
+def test_many_small_leaves_round_trip(tmp_path):
+    """2000-leaf state: manifest, scheduler, and storage must stay
+    linear-ish (regression guard for per-leaf overhead blowups)."""
+    leaves = {f"k{i:04d}": jnp.full((4, 4), i, jnp.float32) for i in range(2000)}
+    state = StateDict(**leaves)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": state})
+    target = StateDict(**{k: jnp.zeros((4, 4), jnp.float32) for k in leaves})
+    Snapshot(path).restore({"s": target})
+    assert float(target["k1999"][0, 0]) == 1999.0
+    assert float(target["k0000"][0, 0]) == 0.0
+    assert len(Snapshot(path).get_manifest()) >= 2000

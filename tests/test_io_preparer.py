@@ -83,17 +83,27 @@ def test_bfloat16_bit_exact():
 def test_dtype_matrix_bit_exact(dtype):
     """Raw-payload serialization must round-trip every dtype a training
     program can hold bit-exactly (SURVEY §7 hard part #4) — including the
-    ml_dtypes families (bfloat16/float8) that lack the buffer protocol."""
-    jdt = getattr(jnp, dtype)
+    ml_dtypes families (bfloat16/float8) that lack the buffer protocol.
+
+    64-bit cases use host numpy arrays: without jax_enable_x64, jnp
+    silently truncates them to 32 bits and the test would be vacuous —
+    and host-side 64-bit state (numpy RNG, progress counters) is exactly
+    what those dtypes hold in practice."""
     rng = np.random.RandomState(0)
-    if dtype == "bool_":
+    if dtype in ("float64", "int64"):
+        arr = np.asarray(
+            rng.randn(9, 5) * 100, dtype=getattr(np, dtype)
+        )
+    elif dtype == "bool_":
         arr = jnp.asarray(rng.rand(9, 5) > 0.5)
     elif dtype == "complex64":
-        arr = jnp.asarray((rng.randn(9, 5) + 1j * rng.randn(9, 5)).astype(np.complex64))
+        arr = jnp.asarray(
+            (rng.randn(9, 5) + 1j * rng.randn(9, 5)).astype(np.complex64)
+        )
     elif dtype.startswith(("int", "uint")):
-        arr = jnp.asarray(rng.randint(0, 100, (9, 5)), dtype=jdt)
+        arr = jnp.asarray(rng.randint(0, 100, (9, 5)), dtype=getattr(jnp, dtype))
     else:
-        arr = jnp.asarray(rng.randn(9, 5), dtype=jdt)
+        arr = jnp.asarray(rng.randn(9, 5), dtype=getattr(jnp, dtype))
     entry, restored, _ = _save_and_load(arr, arr)
     assert restored.dtype == arr.dtype
     a = np.asarray(restored)

@@ -189,3 +189,13 @@ def test_delete_is_idempotent_and_cleans_async_markers(tmp_path):
     assert leftovers == []
     with pytest.raises(FileNotFoundError):
         Snapshot(path).delete()  # metadata already gone
+
+
+def test_inspect_cli_delete(tmp_path, capsys):
+    from torchsnapshot_tpu.inspect import main
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(w=jnp.arange(8, dtype=jnp.float32))})
+    assert main([path, "--delete"]) == 0
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+    assert "deleted" in capsys.readouterr().out

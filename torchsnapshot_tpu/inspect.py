@@ -62,7 +62,17 @@ def main(argv=None) -> int:
     parser.add_argument("path")
     parser.add_argument("--rank", type=int, default=0)
     parser.add_argument("--raw", action="store_true")
+    parser.add_argument(
+        "--delete",
+        action="store_true",
+        help="delete the snapshot (metadata first, then all payloads)",
+    )
     args = parser.parse_args(argv)
+
+    if args.delete:
+        Snapshot(args.path).delete()
+        print(f"deleted {args.path}")
+        return 0
 
     manifest = Snapshot(args.path).get_manifest()
     view = manifest if args.raw else get_available_entries(manifest, args.rank)

@@ -150,9 +150,10 @@ def _maybe_enable_from_env() -> None:
     if not path:
         return
     # One file per process: concurrent ranks/workers sharing the env var
-    # must not clobber each other's trace on flush.
+    # must not clobber each other's trace on flush. Literal replace, not
+    # str.format — an env path with other braces must not crash import.
     if "{pid}" in path:
-        path = path.format(pid=os.getpid())
+        path = path.replace("{pid}", str(os.getpid()))
     else:
         root, ext = os.path.splitext(path)
         path = f"{root}.pid{os.getpid()}{ext or '.json'}"
