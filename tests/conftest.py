@@ -8,20 +8,32 @@ lazily — on first jax.devices() — which happens after this).
 Accelerator-tier escape hatch (the reference's tests/gpu_tests pattern):
 ``TPUSNAPSHOT_TPU_TESTS=1 pytest tests/tpu_tests`` keeps the ambient
 platform (the real TPU) instead. The hatch requires BOTH the env var
-``== "1"`` and an invocation that names tpu_tests: the hermetic suite
-depends on the forced 8-device CPU mesh, so
-``TPUSNAPSHOT_TPU_TESTS=1 pytest tests/`` must not un-force it (the
-tpu tier then simply self-skips on the cpu platform).
+``== "1"`` and an invocation whose test paths all lie inside tpu_tests:
+the hermetic suite depends on the forced 8-device CPU mesh, so a mixed
+or broad invocation (``TPUSNAPSHOT_TPU_TESTS=1 pytest tests/``) keeps
+the forcing and the tpu tier simply self-skips on cpu.
 """
 
 import os
 import sys
 
-_tpu_tier_run = os.environ.get("TPUSNAPSHOT_TPU_TESTS") == "1" and any(
-    "tpu_tests" in arg for arg in sys.argv[1:]
-)
 
-if not _tpu_tier_run:
+def _tpu_tier_invocation() -> bool:
+    if os.environ.get("TPUSNAPSHOT_TPU_TESTS") != "1":
+        return False
+    # Positional args that resolve to existing paths (strip ::nodeid).
+    paths = [
+        a.split("::")[0]
+        for a in sys.argv[1:]
+        if not a.startswith("-") and os.path.exists(a.split("::")[0])
+    ]
+    if paths:
+        return all("tpu_tests" in os.path.abspath(p) for p in paths)
+    # Bare `pytest` run: honor the env var only from inside the tier dir.
+    return os.path.basename(os.getcwd()) == "tpu_tests"
+
+
+if not _tpu_tier_invocation():
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
