@@ -18,6 +18,17 @@ import os
 import sys
 
 
+_TIER_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_tests")
+
+
+def _inside_tier(path: str) -> bool:
+    """Whether ``path`` is the tier directory or inside it (anchored to
+    the resolved dir — a checkout path merely *containing* "tpu_tests"
+    must not satisfy the gate)."""
+    p = os.path.abspath(path)
+    return p == _TIER_DIR or p.startswith(_TIER_DIR + os.sep)
+
+
 def _tpu_tier_invocation() -> bool:
     if os.environ.get("TPUSNAPSHOT_TPU_TESTS") != "1":
         return False
@@ -28,9 +39,9 @@ def _tpu_tier_invocation() -> bool:
         if not a.startswith("-") and os.path.exists(a.split("::")[0])
     ]
     if paths:
-        return all("tpu_tests" in os.path.abspath(p) for p in paths)
+        return all(_inside_tier(p) for p in paths)
     # Bare `pytest` run: honor the env var only from inside the tier dir.
-    return os.path.basename(os.getcwd()) == "tpu_tests"
+    return _inside_tier(os.getcwd())
 
 
 if not _tpu_tier_invocation():

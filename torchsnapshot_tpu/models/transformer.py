@@ -42,6 +42,11 @@ class TransformerConfig:
     d_ff: int = 512
     max_seq_len: int = 128
     dtype: Any = jnp.float32
+    # Use the fused Pallas attention kernel (ops/attention.py) instead of
+    # materializing the S×S score matrix. Off by default: the einsum path
+    # is the numerical reference (the kernel's online softmax reassociates
+    # reductions, so outputs match to float tolerance, not bitwise).
+    flash_attention: bool = False
 
 
 def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
@@ -135,7 +140,21 @@ def forward(
     h = params["embed"][tokens] + params["pos_embed"][:seq_len]
     h = constrain(h.astype(config.dtype))
 
-    mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+    if config.flash_attention and mesh is not None:
+        # pallas_call has no SPMD partitioning rule: under a mesh with
+        # sp-sharded activations it would fail to lower (or silently
+        # replicate), defeating the sequence parallelism this model
+        # advertises. Sharded attention needs a ring/all-to-all kernel —
+        # use the einsum path on meshes until then.
+        raise ValueError(
+            "flash_attention currently supports single-device (per-host) "
+            "execution only; drop the mesh or use the einsum path."
+        )
+    mask = (
+        None
+        if config.flash_attention
+        else jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+    )
     head_dim = config.d_model // config.n_heads
 
     for layer in params["layers"]:
@@ -146,12 +165,30 @@ def forward(
         q = q.reshape(*q.shape[:2], config.n_heads, head_dim)
         k = k.reshape(*k.shape[:2], config.n_heads, head_dim)
         v = v.reshape(*v.shape[:2], config.n_heads, head_dim)
-        scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(head_dim)
-        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-            config.dtype
-        )
-        attn = jnp.einsum("bnqk,bknd->bqnd", probs, v)
+        if config.flash_attention:
+            import math
+
+            from ..ops.attention import flash_attention
+
+            # Largest power-of-two divisor of the sequence length, capped
+            # at the MXU-friendly 128 (seq lengths like 192 would crash a
+            # bare min(128, S) since 128 does not divide them).
+            block = math.gcd(seq_len, 128)
+            attn = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                causal=True,
+                block_q=block,
+                block_k=block,
+            ).transpose(0, 2, 1, 3)
+        else:
+            scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(head_dim)
+            scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(config.dtype)
+            attn = jnp.einsum("bnqk,bknd->bqnd", probs, v)
         attn = attn.reshape(*attn.shape[:2], config.d_model)
         h = h + constrain(jnp.einsum("bsh,hd->bsd", attn, layer["attn"]["wo"]))
 

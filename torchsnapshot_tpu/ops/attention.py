@@ -1,0 +1,212 @@
+"""Fused (flash) attention Pallas kernel for the flagship transformer.
+
+The transformer workload's hot op is attention; materializing the
+[B, H, S, S] score matrix is O(S²) HBM traffic, which is what caps long
+sequences. This kernel computes softmax(QKᵀ)·V with the online-softmax
+recurrence, tiled so only [block_q, block_k] score tiles ever exist —
+they live in VMEM, QKᵀ and P·V run on the MXU, and HBM traffic drops to
+O(S·D). Causal masking skips fully-masked key blocks outright
+(predicated off, not just masked), halving the work of autoregressive
+attention.
+
+Kernel structure (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch·heads, S/block_q, S/block_k); the last axis iterates
+  sequentially on TPU, so the running max/denominator/accumulator live
+  in VMEM scratch that persists across it;
+- accumulation in float32 regardless of input dtype (bf16-safe);
+- on CPU the kernel runs in interpreter mode, so the hermetic test suite
+  exercises the same code path bit-for-bit.
+
+Exposed through the transformer via ``TransformerConfig.flash_attention``
+(off by default: the einsum path remains the numerical reference; the
+kernel reassociates the softmax reduction so results match to float
+tolerance, not bitwise).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: key block kj is entirely in the future of query block qi
+    # iff its first key index exceeds the last query index.
+    run = (
+        (kj * block_k <= qi * block_q + (block_q - 1)) if causal else True
+    )
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+        s = jax.lax.dot_general(
+            q,
+            k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_ref[:]  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(kj == last_k)
+    def _finish():
+        # Fully-masked rows (can't happen with causal self-attention, but
+        # keep the guard) would have l == 0; avoid 0/0.
+        denom = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _reference_attention(q, k, v, causal):
+    """Differentiable einsum attention — the kernel's numerical spec and
+    the recompute target for the backward pass."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (d**0.5)
+    if causal:
+        length = q.shape[2]
+        mask = jnp.tril(jnp.ones((length, length), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, residuals, g):
+    # Backward recomputes attention through the differentiable reference:
+    # training keeps exact einsum gradients while the forward pass (and
+    # anything under stop_gradient/inference) uses the fused kernel. The
+    # backward therefore still materializes S² — the kernel's O(S·D)
+    # memory win applies to forward/inference paths.
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """softmax(QKᵀ/√D)·V without materializing the S×S score matrix."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_forward(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    b, h, s, d = q.shape
+    assert k.shape == v.shape == (b, h, s, d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"sequence length {s} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+
+    grid = (b * h, s // block_q, s // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=1.0 / (d**0.5),
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
