@@ -119,3 +119,20 @@ def test_transformer_flash_nonpow2_seq_and_mesh_guard():
     mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
     with pytest.raises(ValueError, match="single-device"):
         forward(params, tokens, cfg, mesh=mesh)
+
+
+def test_transformer_flash_rejects_sub_mxu_blocks():
+    from torchsnapshot_tpu.models.transformer import (
+        TransformerConfig,
+        forward,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_heads=4, n_layers=1, d_ff=128,
+        max_seq_len=132, flash_attention=True,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 132), 0, 64)
+    with pytest.raises(ValueError, match="power-of-two factor"):
+        forward(params, tokens, cfg)  # gcd(132,128)=4 < 8

@@ -1,0 +1,85 @@
+"""Ring attention (sequence parallelism over the mesh) vs the dense
+einsum reference, on the 8-device virtual mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu.ops.attention import _reference_attention
+from torchsnapshot_tpu.parallel.ring_attention import ring_attention, shard_seq
+
+
+def _qkv(shape, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    return (
+        jax.random.normal(kq, shape, jnp.float32),
+        jax.random.normal(kk, shape, jnp.float32),
+        jax.random.normal(kv, shape, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [(2, 2, 64, 16), (1, 4, 128, 32)])
+def test_ring_matches_dense(shape, causal):
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv(shape, seed=shape[2])
+    qs, ks, vs = (shard_seq(t, mesh) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, causal=causal)
+    assert out.sharding.spec == P(None, None, "sp", None)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_reference_attention(q, k, v, causal)),
+        atol=3e-6,
+        rtol=1e-5,
+    )
+
+
+def test_ring_on_dp_sp_mesh():
+    """Batch AND sequence sharded: the ring rides the sp axis while dp
+    partitions the batch — the long-context layout."""
+    devices = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "sp"))
+    q, k, v = _qkv((4, 2, 64, 16), seed=9)
+    spec = P("dp", None, "sp", None)
+    qs, ks, vs = (
+        jax.device_put(t, NamedSharding(mesh, spec)) for t in (q, k, v)
+    )
+    out = ring_attention(qs, ks, vs, mesh, causal=True)
+    # The batch sharding must survive (a hardcoded seq-only spec would
+    # silently all-gather dp and return the batch replicated).
+    assert out.sharding.spec == spec
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_reference_attention(q, k, v, True)),
+        atol=3e-6,
+        rtol=1e-5,
+    )
+
+
+def test_ring_gradients_flow():
+    """ppermute/fori_loop/cond all differentiate; ring gradients match
+    the dense reference's."""
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv((1, 2, 32, 8), seed=3)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, True) ** 2)
+
+    qs, ks, vs = (shard_seq(t, mesh) for t in (q, k, v))
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(qs, ks, vs)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ring_rejects_indivisible_sequence():
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv((1, 1, 60, 8))
+    with pytest.raises(ValueError, match="divide"):
+        ring_attention(q, k, v, mesh)

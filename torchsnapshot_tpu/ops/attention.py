@@ -163,10 +163,10 @@ def _flash_forward(
     q: jax.Array,  # [B, H, S, D]
     k: jax.Array,
     v: jax.Array,
-    causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
-    interpret: Optional[bool] = None,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,  # resolved by flash_attention(); never None here
 ) -> jax.Array:
     b, h, s, d = q.shape
     assert k.shape == v.shape == (b, h, s, d)
@@ -177,8 +177,6 @@ def _flash_forward(
             f"sequence length {s} must be divisible by block sizes "
             f"({block_q}, {block_k})"
         )
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
 
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)

@@ -1,0 +1,181 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context training shards the sequence axis across devices, but
+attention needs every query to see every key. Ring attention keeps the
+O(S²) score matrix from ever existing globally: each device holds its
+[S/n]-slice of Q/K/V, computes block attention against the K/V slice it
+currently holds, then passes that slice to its ring neighbor over ICI
+(`lax.ppermute`) — n steps later every query has seen every key, with
+per-device memory O((S/n)² ) for the live tile and communication
+perfectly overlappable with compute. The online-softmax recurrence (the
+same one as ops/attention.py's fused kernel) makes the streamed
+accumulation exact, not approximate.
+
+This is the sequence-parallel strategy the task's long-context demand
+calls for, expressed the TPU way: `shard_map` over the mesh's sequence
+axis with XLA collectives, not host-side message passing. Causality is
+handled per (query-chunk, key-chunk) pair: key chunks strictly in the
+future are skipped via `lax.cond` (no FLOPs), the diagonal chunk gets a
+triangular mask, the past is unmasked.
+
+Layout: q, k, v are [B, H, S, D] jax.Arrays sharded P(None, None, axis,
+None) over `mesh`; the result has the same sharding. The reference
+einsum path (ops/attention.py `_reference_attention`) is the numerical
+spec; see tests/test_ring_attention.py.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _chunk_attn(q, k, v, scale, mask):
+    """Block attention of one (q-chunk, k-chunk) pair.
+
+    Returns (unnormalized_out [Bq, D] rows scaled by exp(s - m), row max
+    m [Bq, 1], row denominator l [Bq, 1]) for the online-softmax merge.
+    q: [B, H, Sq, D]; k, v: [B, H, Sk, D]; mask: [Sq, Sk] bool or None.
+    """
+    s = (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    )  # [B, H, Sq, Sk]
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B, H, Sq, 1]
+    # A fully-masked row (possible only pre-merge) has m == -inf; guard
+    # the exp so it contributes zeros, not NaNs.
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def _merge(acc, o, m_new, l_new):
+    """Merge a chunk's (o, m, l) into the running (o, m, l)."""
+    o_run, m_run, l_run = acc
+    m = jnp.maximum(m_run, m_new)
+    alpha = jnp.exp(m_run - m)
+    beta = jnp.exp(m_new - m)
+    return (o_run * alpha + o * beta, m, l_run * alpha + l_new * beta)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, H, S, D], S sharded over `axis`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    spec: Optional[P] = None,
+) -> jax.Array:
+    """Exact softmax(QKᵀ/√D)·V with Q/K/V sequence-sharded over a mesh
+    axis; K/V slices rotate around the ring via ppermute."""
+    b, h, s, d = q.shape
+    n = mesh.shape[axis]
+    if s % n:
+        raise ValueError(f"sequence length {s} must divide over {axis}={n}")
+    chunk = s // n
+    scale = 1.0 / (d**0.5)
+    # Preserve the inputs' full layout (e.g. batch sharded over "dp"):
+    # hardcoding P(None, None, axis, None) would silently all-gather the
+    # batch and return it replicated. The sequence dim must ride `axis`.
+    # Inside a trace (grad/jit), .sharding is unavailable — pass `spec`
+    # explicitly there; bare default otherwise.
+    if spec is None:
+        try:
+            sharding = q.sharding
+        except Exception:
+            sharding = None
+        if isinstance(sharding, NamedSharding) and sharding.spec:
+            spec = sharding.spec
+    if spec is not None:
+        in_spec = spec
+        seq_entry = in_spec[2] if len(in_spec) > 2 else None
+        seq_axes = (
+            seq_entry if isinstance(seq_entry, tuple) else (seq_entry,)
+        )
+        if seq_axes != (axis,):
+            # The ring-position arithmetic assumes `axis` is the one and
+            # only sharding of the sequence dim.
+            raise ValueError(
+                f"q's sequence dim is sharded {seq_entry!r}; ring "
+                f"attention requires it sharded exactly over {axis!r}"
+            )
+        spec = P(*(tuple(in_spec) + (None,) * (4 - len(in_spec))))
+    else:
+        spec = P(None, None, axis, None)
+
+    def local(qc, kc, vc):
+        # qc/kc/vc: this device's local slice — batch/head dims may be
+        # sharded over other mesh axes; the seq dim is exactly `chunk`.
+        my_idx = jax.lax.axis_index(axis)
+        b_local, h_local = qc.shape[0], qc.shape[1]
+
+        tri = jnp.tril(jnp.ones((chunk, chunk), dtype=bool))
+
+        def accumulate(i, acc, k_cur, v_cur):
+            o_run, m_run, l_run = acc
+            # After i rotations of send-to-next, this device holds the
+            # K/V chunk originally owned by device (my_idx - i) mod n.
+            src = (my_idx - i) % n
+
+            def masked(mask):
+                o, m, l = _chunk_attn(qc, k_cur, v_cur, scale, mask)
+                return _merge((o_run, m_run, l_run), o, m, l)
+
+            if not causal:
+                return masked(None)
+            return jax.lax.cond(
+                src < my_idx,
+                lambda: masked(None),  # fully in the past
+                lambda: jax.lax.cond(
+                    src == my_idx,
+                    lambda: masked(tri),  # diagonal chunk
+                    lambda: (o_run, m_run, l_run),  # future: skip
+                ),
+            )
+
+        def step(i, carry):
+            acc = carry[:3]
+            k_cur, v_cur = carry[3], carry[4]
+            acc = accumulate(i, acc, k_cur, v_cur)
+            k_nxt = jax.lax.ppermute(
+                k_cur, axis, [(j, (j + 1) % n) for j in range(n)]
+            )
+            v_nxt = jax.lax.ppermute(
+                v_cur, axis, [(j, (j + 1) % n) for j in range(n)]
+            )
+            return (*acc, k_nxt, v_nxt)
+
+        o0 = jnp.zeros((b_local, h_local, chunk, d), jnp.float32)
+        m0 = jnp.full((b_local, h_local, chunk, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b_local, h_local, chunk, 1), jnp.float32)
+        # Rotate only between chunk computations: n-1 looped steps that
+        # each compute-then-rotate, then the last chunk outside the loop
+        # (rotating after it would be a discarded ICI hop).
+        carry = jax.lax.fori_loop(0, n - 1, step, (o0, m0, l0, kc, vc))
+        o_run, m_run, l_run = accumulate(n - 1, carry[:3], carry[3], carry[4])
+        denom = jnp.where(l_run == 0.0, 1.0, l_run)
+        return (o_run / denom).astype(qc.dtype)
+
+    shard_fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return shard_fn(q, k, v)
+
+
+def shard_seq(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
+    """Place [B, H, S, D] with the sequence dim sharded over `axis`."""
+    return jax.device_put(x, NamedSharding(mesh, P(None, None, axis, None)))
