@@ -1,0 +1,66 @@
+"""Long-context training slice: ring attention over a sequence-sharded
+mesh, with checkpoint/resume of the sp-sharded state.
+
+Demonstrates the two long-context pieces working together:
+
+1. `ring_attention` computes exact causal attention with Q/K/V sharded
+   over the mesh's "sp" axis — no device ever holds the S×S score
+   matrix or the full sequence.
+2. `Snapshot.take`/`restore` checkpoint the sequence-sharded activations
+   /state like any sharded array (offsets derived from shard indices),
+   including elastic restore onto a narrower mesh.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/long_context_example.py
+"""
+
+import tempfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.ops.attention import _reference_attention
+from torchsnapshot_tpu.parallel.ring_attention import ring_attention, shard_seq
+
+
+def main() -> None:
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    B, H, S, D = 1, 4, 512 * n, 32  # sequence scales with the mesh
+    print(f"{n}-way sequence parallelism, {S} tokens")
+
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = shard_seq(jax.random.normal(kq, (B, H, S, D), jnp.float32), mesh)
+    k = shard_seq(jax.random.normal(kk, (B, H, S, D), jnp.float32), mesh)
+    v = shard_seq(jax.random.normal(kv, (B, H, S, D), jnp.float32), mesh)
+
+    out = ring_attention(q, k, v, mesh, causal=True)
+    expected = _reference_attention(
+        np.asarray(q), np.asarray(k), np.asarray(v), True
+    )
+    err = float(jnp.abs(out - expected).max())
+    assert err < 1e-5, err
+    print(f"ring == dense reference (max err {err:.1e}); "
+          f"output sharding {out.sharding.spec}")
+
+    # Checkpoint the sp-sharded tensors; restore onto a half-size mesh.
+    with tempfile.TemporaryDirectory() as tmp:
+        Snapshot.take(f"{tmp}/snap", {"s": StateDict(kv_cache_k=k, kv_cache_v=v)})
+        half = Mesh(np.array(jax.devices()[: max(1, n // 2)]), ("sp",))
+        target = StateDict(
+            kv_cache_k=shard_seq(jnp.zeros((B, H, S, D), jnp.float32), half),
+            kv_cache_v=shard_seq(jnp.zeros((B, H, S, D), jnp.float32), half),
+        )
+        Snapshot(f"{tmp}/snap").restore({"s": target})
+        np.testing.assert_array_equal(
+            np.asarray(target["kv_cache_k"]), np.asarray(k)
+        )
+    print(f"OK: sp-sharded state round-tripped onto a {max(1, n // 2)}-way mesh")
+
+
+if __name__ == "__main__":
+    main()

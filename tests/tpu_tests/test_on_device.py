@@ -88,3 +88,20 @@ def test_flash_attention_kernel_on_device():
     )
     err = float(jnp.abs(out.astype(jnp.float32) - expected).max())
     assert err < 2e-2, err
+
+
+def test_flash_long_context_on_device():
+    """32k-token causal attention on one chip: the fused kernel's O(S·D)
+    memory is what makes this run at all — the dense path's score matrix
+    would need B·H·S² f32 = 34 GB of HBM."""
+    from torchsnapshot_tpu.ops.attention import flash_attention
+
+    S = 32768
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(kq, (1, 8, S, 64), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 8, S, 64), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 8, S, 64), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    out.block_until_ready()
+    assert out.shape == (1, 8, S, 64)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())

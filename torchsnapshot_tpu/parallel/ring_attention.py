@@ -22,9 +22,16 @@ Layout: q, k, v are [B, H, S, D] jax.Arrays sharded P(None, None, axis,
 None) over `mesh`; the result has the same sharding. The reference
 einsum path (ops/attention.py `_reference_attention`) is the numerical
 spec; see tests/test_ring_attention.py.
+
+Known causal load imbalance (contiguous layout): the device holding the
+last sequence chunk computes n chunk-attentions while device 0 computes
+one, and each ring step barriers on the ppermute — so causal wall-clock
+tracks the busiest device (~2× a balanced layout). The standard fix is
+a striped/zigzag token layout (each device holds chunks i and 2n-1-i),
+which equalizes causal work; it changes the on-device token order, so
+it is left for a layout-aware integration pass.
 """
 
-import functools
 from typing import Optional
 
 import jax
