@@ -23,13 +23,14 @@ None) over `mesh`; the result has the same sharding. The reference
 einsum path (ops/attention.py `_reference_attention`) is the numerical
 spec; see tests/test_ring_attention.py.
 
-Known causal load imbalance (contiguous layout): the device holding the
-last sequence chunk computes n chunk-attentions while device 0 computes
-one, and each ring step barriers on the ppermute — so causal wall-clock
-tracks the busiest device (~2× a balanced layout). The standard fix is
-a striped/zigzag token layout (each device holds chunks i and 2n-1-i),
-which equalizes causal work; it changes the on-device token order, so
-it is left for a layout-aware integration pass.
+Causal load balance: under the contiguous layout (`ring_attention`) the
+device holding the last sequence chunk computes n chunk-attentions while
+device 0 computes one, and each ring step barriers on the ppermute — so
+causal wall-clock tracks the busiest device (~2× a balanced layout).
+`ring_attention_zigzag` fixes this: device j holds sub-chunks j and
+2n-1-j (`to_zigzag`/`from_zigzag` permute at the loop boundary), making
+per-device causal work constant while staying exact w.r.t. the original
+token order.
 """
 
 from typing import Optional
@@ -74,6 +75,45 @@ def _merge(acc, o, m_new, l_new):
     return (o_run * alpha + o * beta, m, l_run * alpha + l_new * beta)
 
 
+def _resolve_spec(
+    q: jax.Array, axis: str, spec: Optional[P]
+) -> P:
+    """Preserve the inputs' full layout (e.g. batch sharded over "dp"):
+    hardcoding P(None, None, axis, None) would silently all-gather the
+    batch and return it replicated. The sequence dim must ride exactly
+    `axis` (the ring-position arithmetic assumes it). Inside a trace
+    (grad/jit), ``.sharding`` is unavailable — pass ``spec`` explicitly
+    there; bare default otherwise."""
+    if spec is None:
+        try:
+            sharding = q.sharding
+        except Exception:
+            sharding = None
+        if isinstance(sharding, NamedSharding) and sharding.spec:
+            spec = sharding.spec
+    if spec is None:
+        return P(None, None, axis, None)
+    seq_entry = spec[2] if len(spec) > 2 else None
+    seq_axes = seq_entry if isinstance(seq_entry, tuple) else (seq_entry,)
+    if seq_axes != (axis,):
+        raise ValueError(
+            f"q's sequence dim is sharded {seq_entry!r}; ring "
+            f"attention requires it sharded exactly over {axis!r}"
+        )
+    return P(*(tuple(spec) + (None,) * (4 - len(spec))))
+
+
+def _rotate(x: jax.Array, axis: str, n: int) -> jax.Array:
+    """Send this device's slice to its ring successor."""
+    return jax.lax.ppermute(x, axis, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _norm(acc):
+    """Normalize an online-softmax accumulator; guard all-masked rows."""
+    o_run, _, l_run = acc
+    return o_run / jnp.where(l_run == 0.0, 1.0, l_run)
+
+
 def ring_attention(
     q: jax.Array,  # [B, H, S, D], S sharded over `axis`
     k: jax.Array,
@@ -88,37 +128,12 @@ def ring_attention(
     b, h, s, d = q.shape
     n = mesh.shape[axis]
     if s % n:
-        raise ValueError(f"sequence length {s} must divide over {axis}={n}")
+        raise ValueError(
+            f"sequence length {s} must be divisible by {axis}={n}"
+        )
     chunk = s // n
     scale = 1.0 / (d**0.5)
-    # Preserve the inputs' full layout (e.g. batch sharded over "dp"):
-    # hardcoding P(None, None, axis, None) would silently all-gather the
-    # batch and return it replicated. The sequence dim must ride `axis`.
-    # Inside a trace (grad/jit), .sharding is unavailable — pass `spec`
-    # explicitly there; bare default otherwise.
-    if spec is None:
-        try:
-            sharding = q.sharding
-        except Exception:
-            sharding = None
-        if isinstance(sharding, NamedSharding) and sharding.spec:
-            spec = sharding.spec
-    if spec is not None:
-        in_spec = spec
-        seq_entry = in_spec[2] if len(in_spec) > 2 else None
-        seq_axes = (
-            seq_entry if isinstance(seq_entry, tuple) else (seq_entry,)
-        )
-        if seq_axes != (axis,):
-            # The ring-position arithmetic assumes `axis` is the one and
-            # only sharding of the sequence dim.
-            raise ValueError(
-                f"q's sequence dim is sharded {seq_entry!r}; ring "
-                f"attention requires it sharded exactly over {axis!r}"
-            )
-        spec = P(*(tuple(in_spec) + (None,) * (4 - len(in_spec))))
-    else:
-        spec = P(None, None, axis, None)
+    spec = _resolve_spec(q, axis, spec)
 
     def local(qc, kc, vc):
         # qc/kc/vc: this device's local slice — batch/head dims may be
@@ -154,13 +169,7 @@ def ring_attention(
             acc = carry[:3]
             k_cur, v_cur = carry[3], carry[4]
             acc = accumulate(i, acc, k_cur, v_cur)
-            k_nxt = jax.lax.ppermute(
-                k_cur, axis, [(j, (j + 1) % n) for j in range(n)]
-            )
-            v_nxt = jax.lax.ppermute(
-                v_cur, axis, [(j, (j + 1) % n) for j in range(n)]
-            )
-            return (*acc, k_nxt, v_nxt)
+            return (*acc, _rotate(k_cur, axis, n), _rotate(v_cur, axis, n))
 
         o0 = jnp.zeros((b_local, h_local, chunk, d), jnp.float32)
         m0 = jnp.full((b_local, h_local, chunk, 1), _NEG_INF, jnp.float32)
@@ -169,9 +178,8 @@ def ring_attention(
         # each compute-then-rotate, then the last chunk outside the loop
         # (rotating after it would be a discarded ICI hop).
         carry = jax.lax.fori_loop(0, n - 1, step, (o0, m0, l0, kc, vc))
-        o_run, m_run, l_run = accumulate(n - 1, carry[:3], carry[3], carry[4])
-        denom = jnp.where(l_run == 0.0, 1.0, l_run)
-        return (o_run / denom).astype(qc.dtype)
+        acc = accumulate(n - 1, carry[:3], carry[3], carry[4])
+        return _norm(acc).astype(qc.dtype)
 
     shard_fn = jax.shard_map(
         local,
@@ -186,3 +194,122 @@ def ring_attention(
 def shard_seq(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
     """Place [B, H, S, D] with the sequence dim sharded over `axis`."""
     return jax.device_put(x, NamedSharding(mesh, P(None, None, axis, None)))
+
+
+# --------------------------------------------------------------- zigzag
+
+def zigzag_indices(s: int, n: int) -> jnp.ndarray:
+    """Token permutation for the balanced causal layout: device j holds
+    sub-chunks j and 2n-1-j of size s/(2n). Summed causal work per
+    device is then constant ((j+1) + (2n-j) sub-chunk attentions), so no
+    device waits ~2× on the busiest one (the contiguous layout's
+    imbalance, see module docstring). Returns indices such that
+    ``x[..., idx, :]`` is in zigzag order."""
+    if s % (2 * n):
+        raise ValueError(
+            f"sequence length {s} must be divisible by 2*n={2 * n}"
+        )
+    c = s // (2 * n)
+    order = []
+    for j in range(n):
+        order.extend(range(j * c, (j + 1) * c))
+        order.extend(range((2 * n - 1 - j) * c, (2 * n - j) * c))
+    return jnp.asarray(order, jnp.int32)
+
+
+def to_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
+    """Permute [B, H, S, D] into zigzag order and shard over `axis`."""
+    idx = zigzag_indices(x.shape[2], mesh.shape[axis])
+    return shard_seq(jnp.take(x, idx, axis=2), mesh, axis)
+
+
+def from_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
+    """Invert :func:`to_zigzag` (result stays sharded over `axis`)."""
+    idx = zigzag_indices(x.shape[2], mesh.shape[axis])
+    inv = jnp.argsort(idx)
+    return shard_seq(jnp.take(x, inv, axis=2), mesh, axis)
+
+
+def ring_attention_zigzag(
+    q: jax.Array,  # [B, H, S, D] in ZIGZAG token order, S sharded on axis
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    spec: Optional[P] = None,
+) -> jax.Array:
+    """Causal ring attention over zigzag-ordered inputs (balanced work).
+
+    Inputs and output are in zigzag token order (use
+    :func:`to_zigzag`/:func:`from_zigzag` at the loop boundary — training
+    loops keep all sequence tensors zigzag-ordered so the permutes happen
+    once at data loading, not per step). Causality is enforced w.r.t. the
+    ORIGINAL token order via global sub-chunk ids.
+    """
+    b, h, s, d = q.shape
+    n = mesh.shape[axis]
+    if s % (2 * n):
+        raise ValueError(
+            f"sequence length {s} must be divisible by 2*{axis}={2 * n}"
+        )
+    c = s // (2 * n)  # sub-chunk length
+    scale = 1.0 / (d**0.5)
+    spec = _resolve_spec(q, axis, spec)
+
+    def local(qc, kc, vc):
+        my = jax.lax.axis_index(axis)
+        # Local halves and their global sub-chunk ids.
+        q_lo, q_hi = qc[:, :, :c], qc[:, :, c:]
+        q_ids = (my, 2 * n - 1 - my)
+
+        tri = jnp.tril(jnp.ones((c, c), dtype=bool))
+
+        def sub_step(acc, q_sub, q_id, k_sub, v_sub, k_id):
+            def attend(mask):
+                o, m, l = _chunk_attn(q_sub, k_sub, v_sub, scale, mask)
+                return _merge(acc, o, m, l)
+
+            return jax.lax.cond(
+                k_id < q_id,
+                lambda: attend(None),
+                lambda: jax.lax.cond(
+                    k_id == q_id, lambda: attend(tri), lambda: acc
+                ),
+            )
+
+        def accumulate_both(i, acc_lo, acc_hi, k_cur, v_cur):
+            src = (my - i) % n
+            for half, k_id in ((0, src), (1, 2 * n - 1 - src)):
+                k_sub = k_cur[:, :, half * c : (half + 1) * c]
+                v_sub = v_cur[:, :, half * c : (half + 1) * c]
+                acc_lo = sub_step(acc_lo, q_lo, q_ids[0], k_sub, v_sub, k_id)
+                acc_hi = sub_step(acc_hi, q_hi, q_ids[1], k_sub, v_sub, k_id)
+            return acc_lo, acc_hi
+
+        def step(i, carry):
+            acc_lo, acc_hi, k_cur, v_cur = carry
+            acc_lo, acc_hi = accumulate_both(i, acc_lo, acc_hi, k_cur, v_cur)
+            return (acc_lo, acc_hi, _rotate(k_cur, axis, n), _rotate(v_cur, axis, n))
+
+        def init():
+            bl, hl = qc.shape[0], qc.shape[1]
+            return (
+                jnp.zeros((bl, hl, c, d), jnp.float32),
+                jnp.full((bl, hl, c, 1), _NEG_INF, jnp.float32),
+                jnp.zeros((bl, hl, c, 1), jnp.float32),
+            )
+
+        carry = jax.lax.fori_loop(0, n - 1, step, (init(), init(), kc, vc))
+        acc_lo, acc_hi = accumulate_both(n - 1, carry[0], carry[1], carry[2], carry[3])
+        return jnp.concatenate(
+            [_norm(acc_lo), _norm(acc_hi)], axis=2
+        ).astype(qc.dtype)
+
+    shard_fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return shard_fn(q, k, v)
