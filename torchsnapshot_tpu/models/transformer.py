@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention
 from ..parallel.mesh import shard_pytree
+from ..parallel.ring_attention import ring_attention
 
 
 @dataclass(frozen=True)
@@ -49,6 +50,11 @@ class TransformerConfig:
     # is the numerical reference (the kernel's online softmax reassociates
     # reductions, so outputs match to float tolerance, not bitwise).
     flash_attention: bool = False
+    # Use ring attention (parallel/ring_attention.py) over the mesh's
+    # "sp" axis: exact attention with K/V slices rotating over ICI, so no
+    # device gathers the full sequence — the long-context path. Requires
+    # a mesh with an "sp" axis; mutually exclusive with flash_attention.
+    ring_attention: bool = False
 
 
 def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
@@ -138,10 +144,22 @@ def forward(
             )
         return x
 
+    def constrain4(x, spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
     _, seq_len = tokens.shape
     h = params["embed"][tokens] + params["pos_embed"][:seq_len]
     h = constrain(h.astype(config.dtype))
 
+    if config.ring_attention:
+        if config.flash_attention:
+            raise ValueError(
+                "flash_attention and ring_attention are mutually exclusive"
+            )
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                'ring_attention requires a mesh with an "sp" axis'
+            )
     if config.flash_attention and mesh is not None:
         # pallas_call has no SPMD partitioning rule: under a mesh with
         # sp-sharded activations it would fail to lower (or silently
@@ -154,7 +172,7 @@ def forward(
         )
     mask = (
         None
-        if config.flash_attention
+        if (config.flash_attention or config.ring_attention)
         else jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
     )
     head_dim = config.d_model // config.n_heads
@@ -189,6 +207,30 @@ def forward(
                 causal=True,
                 block_q=block,
                 block_k=block,
+            ).transpose(0, 2, 1, 3)
+        elif config.ring_attention:
+            # [B, S, H, Dh] -> [B, H, S, Dh]: sequence rides "sp", batch
+            # rides "dp", and heads ride "tp" (q/k/v are tp-column-
+            # sharded already — replicating heads here would all-gather
+            # them and redo attention tp-fold); shard_map inside the jit
+            # trace needs the spec passed explicitly.
+            names = mesh.axis_names
+            head_axis = (
+                "tp"
+                if "tp" in names and config.n_heads % mesh.shape["tp"] == 0
+                else None
+            )
+            ring_spec = P(
+                "dp" if "dp" in names else None, head_axis, "sp", None
+            )
+            attn = ring_attention(
+                constrain4(q.transpose(0, 2, 1, 3), ring_spec),
+                constrain4(k.transpose(0, 2, 1, 3), ring_spec),
+                constrain4(v.transpose(0, 2, 1, 3), ring_spec),
+                mesh,
+                axis="sp",
+                causal=True,
+                spec=ring_spec,
             ).transpose(0, 2, 1, 3)
         else:
             scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(head_dim)

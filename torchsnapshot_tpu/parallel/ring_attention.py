@@ -217,17 +217,39 @@ def zigzag_indices(s: int, n: int) -> jnp.ndarray:
     return jnp.asarray(order, jnp.int32)
 
 
+def _zigzag_target_spec(x: jax.Array, mesh: Mesh, axis: str) -> P:
+    """Keep the input's batch/head shardings (a bare seq-only spec would
+    silently all-gather a dp-sharded batch); only the sequence dim is
+    forced onto `axis`."""
+    try:
+        sharding = x.sharding
+    except Exception:
+        sharding = None
+    if isinstance(sharding, NamedSharding) and sharding.spec:
+        entries = list(sharding.spec) + [None] * (4 - len(sharding.spec))
+        entries[2] = axis
+        return P(*entries)
+    return P(None, None, axis, None)
+
+
 def to_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
-    """Permute [B, H, S, D] into zigzag order and shard over `axis`."""
+    """Permute [B, H, S, D] into zigzag order and shard the sequence dim
+    over `axis` (other dims keep their shardings)."""
     idx = zigzag_indices(x.shape[2], mesh.shape[axis])
-    return shard_seq(jnp.take(x, idx, axis=2), mesh, axis)
+    spec = _zigzag_target_spec(x, mesh, axis)
+    return jax.device_put(
+        jnp.take(x, idx, axis=2), NamedSharding(mesh, spec)
+    )
 
 
 def from_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
-    """Invert :func:`to_zigzag` (result stays sharded over `axis`)."""
+    """Invert :func:`to_zigzag` (shardings preserved)."""
     idx = zigzag_indices(x.shape[2], mesh.shape[axis])
     inv = jnp.argsort(idx)
-    return shard_seq(jnp.take(x, inv, axis=2), mesh, axis)
+    spec = _zigzag_target_spec(x, mesh, axis)
+    return jax.device_put(
+        jnp.take(x, inv, axis=2), NamedSharding(mesh, spec)
+    )
 
 
 def ring_attention_zigzag(
