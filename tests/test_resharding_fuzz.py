@@ -60,16 +60,25 @@ def _random_mesh_and_spec(rng, shape):
     return mesh, P(*spec)
 
 
-CASES = list(range(12))
+CASES = list(range(20))
 
 
 @pytest.mark.parametrize("case", CASES)
 def test_random_reshard_roundtrip(tmp_path, case, monkeypatch):
+    import ml_dtypes
+
     rng = random.Random(1234 + case)
     ndim = rng.choice([1, 2, 3])
     shape = tuple(rng.choice([1, 3, 4, 8, 12, 16]) for _ in range(ndim))
-    dtype = rng.choice([np.float32, np.int32, np.float16])
-    data = np.arange(int(np.prod(shape)), dtype=dtype).reshape(shape)
+    # 4-, 2-, and 1-byte dtypes: chunk/overlap math works in bytes, so
+    # itemsize interacts with every boundary computation; bfloat16 also
+    # exercises the ml_dtypes (no buffer protocol) payload path.
+    dtype = rng.choice(
+        [np.float32, np.int32, np.float16, ml_dtypes.bfloat16, np.int8]
+    )
+    data = (
+        np.arange(int(np.prod(shape))).astype(dtype).reshape(shape)
+    )
 
     # Force chunk subdivision on moderately-sized arrays; 100 is not a
     # multiple of any itemsize*row so chunk boundaries land mid-row.

@@ -75,6 +75,21 @@ def _merge(acc, o, m_new, l_new):
     return (o_run * alpha + o * beta, m, l_run * alpha + l_new * beta)
 
 
+def _infer_spec_padded(x: jax.Array, spec: Optional[P]) -> Optional[P]:
+    """``spec`` if given, else the array's NamedSharding spec, padded to
+    4 entries; None when unavailable (e.g. tracers hide ``.sharding``)."""
+    if spec is None:
+        try:
+            sharding = x.sharding
+        except Exception:
+            sharding = None
+        if isinstance(sharding, NamedSharding) and sharding.spec:
+            spec = sharding.spec
+    if spec is None:
+        return None
+    return P(*(tuple(spec) + (None,) * (4 - len(spec))))
+
+
 def _resolve_spec(
     q: jax.Array, axis: str, spec: Optional[P]
 ) -> P:
@@ -84,23 +99,17 @@ def _resolve_spec(
     `axis` (the ring-position arithmetic assumes it). Inside a trace
     (grad/jit), ``.sharding`` is unavailable — pass ``spec`` explicitly
     there; bare default otherwise."""
-    if spec is None:
-        try:
-            sharding = q.sharding
-        except Exception:
-            sharding = None
-        if isinstance(sharding, NamedSharding) and sharding.spec:
-            spec = sharding.spec
+    spec = _infer_spec_padded(q, spec)
     if spec is None:
         return P(None, None, axis, None)
-    seq_entry = spec[2] if len(spec) > 2 else None
+    seq_entry = spec[2]
     seq_axes = seq_entry if isinstance(seq_entry, tuple) else (seq_entry,)
     if seq_axes != (axis,):
         raise ValueError(
             f"q's sequence dim is sharded {seq_entry!r}; ring "
             f"attention requires it sharded exactly over {axis!r}"
         )
-    return P(*(tuple(spec) + (None,) * (4 - len(spec))))
+    return spec
 
 
 def _rotate(x: jax.Array, axis: str, n: int) -> jax.Array:
@@ -217,38 +226,42 @@ def zigzag_indices(s: int, n: int) -> jnp.ndarray:
     return jnp.asarray(order, jnp.int32)
 
 
-def _zigzag_target_spec(x: jax.Array, mesh: Mesh, axis: str) -> P:
+def _zigzag_target_spec(
+    x: jax.Array, axis: str, spec: Optional[P]
+) -> P:
     """Keep the input's batch/head shardings (a bare seq-only spec would
     silently all-gather a dp-sharded batch); only the sequence dim is
-    forced onto `axis`."""
-    try:
-        sharding = x.sharding
-    except Exception:
-        sharding = None
-    if isinstance(sharding, NamedSharding) and sharding.spec:
-        entries = list(sharding.spec) + [None] * (4 - len(sharding.spec))
-        entries[2] = axis
-        return P(*entries)
-    return P(None, None, axis, None)
+    forced onto `axis`. Pass ``spec`` explicitly under jit/grad (tracers
+    hide ``.sharding`` and the fallback would drop the batch sharding)."""
+    inferred = _infer_spec_padded(x, spec)
+    if inferred is None:
+        return P(None, None, axis, None)
+    entries = list(inferred)
+    entries[2] = axis
+    return P(*entries)
 
 
-def to_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
+def to_zigzag(
+    x: jax.Array, mesh: Mesh, axis: str = "sp", spec: Optional[P] = None
+) -> jax.Array:
     """Permute [B, H, S, D] into zigzag order and shard the sequence dim
     over `axis` (other dims keep their shardings)."""
     idx = zigzag_indices(x.shape[2], mesh.shape[axis])
-    spec = _zigzag_target_spec(x, mesh, axis)
+    target = _zigzag_target_spec(x, axis, spec)
     return jax.device_put(
-        jnp.take(x, idx, axis=2), NamedSharding(mesh, spec)
+        jnp.take(x, idx, axis=2), NamedSharding(mesh, target)
     )
 
 
-def from_zigzag(x: jax.Array, mesh: Mesh, axis: str = "sp") -> jax.Array:
+def from_zigzag(
+    x: jax.Array, mesh: Mesh, axis: str = "sp", spec: Optional[P] = None
+) -> jax.Array:
     """Invert :func:`to_zigzag` (shardings preserved)."""
     idx = zigzag_indices(x.shape[2], mesh.shape[axis])
     inv = jnp.argsort(idx)
-    spec = _zigzag_target_spec(x, mesh, axis)
+    target = _zigzag_target_spec(x, axis, spec)
     return jax.device_put(
-        jnp.take(x, inv, axis=2), NamedSharding(mesh, spec)
+        jnp.take(x, inv, axis=2), NamedSharding(mesh, target)
     )
 
 
