@@ -41,16 +41,30 @@ def test_flash_matches_reference(shape, bq, bk, causal):
     )
 
 
-def test_flash_gradients_match_reference():
-    """The custom VJP recomputes through the einsum reference, so flash
-    gradients equal reference gradients exactly (same trace)."""
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize(
+    "bq,bk",
+    [
+        (128, 128),  # 1x1 grid
+        (32, 64),  # multi-block: accumulation + causal block skipping
+        (64, 32),  # swapped: uneven grids both ways
+    ],
+)
+def test_flash_gradients_match_reference(bq, bk, causal):
+    """The tiled Pallas backward (p reconstructed from the saved
+    log-sum-exp) must match the einsum reference's gradients — including
+    across multi-block grids, where the dk/dv accumulators persist over
+    query blocks and causal tiles are skipped."""
     q, k, v = _qkv((1, 2, 128, 32), seed=7)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+            ** 2
+        )
 
     def loss_ref(q, k, v):
-        return jnp.sum(_reference_attention(q, k, v, True) ** 2)
+        return jnp.sum(_reference_attention(q, k, v, causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)

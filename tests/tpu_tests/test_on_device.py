@@ -105,3 +105,26 @@ def test_flash_long_context_on_device():
     out.block_until_ready()
     assert out.shape == (1, 8, S, 64)
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_flash_long_context_gradients_on_device():
+    """Training-path long context: grads at 16k tokens on one chip. The
+    tiled Pallas backward reconstructs p per tile from the saved
+    log-sum-exp — a dense backward would materialize B·H·S² probability
+    + score tensors (~17 GB here)."""
+    from torchsnapshot_tpu.ops.attention import flash_attention
+
+    S = 16384
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(kq, (1, 8, S, 64), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 8, S, 64), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 8, S, 64), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(grads)
+    for g in grads:
+        assert g.shape == (1, 8, S, 64)
+        assert bool(jnp.isfinite(g.astype(jnp.float32)).all())

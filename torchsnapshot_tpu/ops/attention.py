@@ -17,6 +17,12 @@ Kernel structure (see /opt/skills/guides/pallas_guide.md):
 - on CPU the kernel runs in interpreter mode, so the hermetic test suite
   exercises the same code path bit-for-bit.
 
+The backward pass is also tiled Pallas: the forward saves the per-row
+log-sum-exp, and two kernels reconstruct p = exp(s - lse) per tile to
+accumulate dq (over key blocks) and dk/dv (over query blocks) — the
+score matrix never materializes in either direction, so the O(S·D)
+memory bound holds for training too.
+
 Exposed through the transformer via ``TransformerConfig.flash_attention``
 (off by default: the einsum path remains the numerical reference; the
 kernel reassociates the softmax reduction so results match to float
@@ -34,11 +40,30 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _causal_positions(qi, kj, block_q: int, block_k: int):
+    """Global (q_pos, k_pos) grids for one (q-block, k-block) tile —
+    the single source of the position math shared by the forward and
+    backward kernels."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos, k_pos
+
+
+def _block_visible(qi, kj, block_q: int, block_k: int):
+    """Whether any key of block kj is visible (causally) to block qi."""
+    return kj * block_k <= qi * block_q + (block_q - 1)
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     acc_ref,
     m_ref,
     l_ref,
@@ -60,9 +85,7 @@ def _flash_kernel(
 
     # Causal: key block kj is entirely in the future of query block qi
     # iff its first key index exceeds the last query index.
-    run = (
-        (kj * block_k <= qi * block_q + (block_q - 1)) if causal else True
-    )
+    run = _block_visible(qi, kj, block_q, block_k) if causal else True
 
     @pl.when(run)
     def _step():
@@ -76,12 +99,7 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
+            q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
         m_prev = m_ref[:]  # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -102,6 +120,110 @@ def _flash_kernel(
         # keep the guard) would have l == 0; avoid 0/0.
         denom = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # Log-sum-exp per row, consumed by the backward kernels to
+        # reconstruct p = exp(s - lse) without storing the score matrix.
+        lse_ref[0] = m_ref[:] + jnp.log(denom)
+
+
+def _bwd_pieces(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, scale,
+                causal, qi, kj, block_q, block_k):
+    """Recompute p and ds for one (q-block, k-block) pair — the shared
+    core of both backward kernels. Returns (p, ds), both [block_q,
+    block_k] float32."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    # All-masked rows (forward wrote lse = -1e30) must yield p = 0, not
+    # exp(s + 1e30) = inf: clamp for the exp, then zero those rows.
+    lse_raw = lse_ref[0]
+    lse_safe = jnp.maximum(lse_raw, _NEG_INF / 2)
+    p = jnp.where(lse_raw > _NEG_INF / 2, jnp.exp(s - lse_safe), 0.0)
+    if causal:
+        q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
+        p = jnp.where(k_pos <= q_pos, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, block_k]
+    ds = p * (dp - delta_ref[0])
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, causal, block_q, block_k,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _block_visible(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        _, ds = _bwd_pieces(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, qi=qi, kj=kj,
+            block_q=block_q, block_k=block_k,
+        )
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, block_q, block_k,
+):
+    # Grid: (bh, n_k, n_q) — the q-block axis iterates sequentially so
+    # the dk/dv accumulators persist across it.
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: q block strictly before the k block contributes nothing.
+    run = _block_visible(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        p, ds = _bwd_pieces(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, qi=qi, kj=kj,
+            block_q=block_q, block_k=block_k,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do_ref[0].astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # pᵀ·dO [block_k, d]
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dsᵀ·q [block_k, d]
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _reference_attention(q, k, v, causal):
@@ -119,26 +241,103 @@ def _reference_attention(q, k, v, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, residuals, g):
-    # Backward recomputes attention through the differentiable reference:
-    # training keeps exact einsum gradients while the forward pass (and
-    # anything under stop_gradient/inference) uses the fused kernel. The
-    # backward therefore still materializes S² — the kernel's O(S·D)
-    # memory win applies to forward/inference paths.
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    # Tiled Pallas backward: p is reconstructed per tile from the saved
+    # log-sum-exp, so the backward, like the forward, never materializes
+    # the S×S score matrix (O(S·D) memory end to end). Two kernels: dq
+    # accumulates over key blocks; dk/dv accumulate over query blocks.
+    q, k, v, out, lse = residuals
+    # delta_i = rowsum(dO_i · O_i) — the softmax-jacobian correction.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+    dq, dk, dv = _flash_backward(
+        q, k, v, g, lse, delta, causal, block_q, block_k, interpret
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _resolve_blocks(s: int, block_q: int, block_k: int):
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"sequence length {s} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    return block_q, block_k
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    block_q, block_k = _resolve_blocks(s, block_q, block_k)
+    scale = 1.0 / (d**0.5)
+    bh = b * h
+    flat = lambda x: x.reshape(bh, s, x.shape[-1])  # noqa: E731
+    qf, kf, vf, gf = flat(q), flat(k), flat(v), flat(g)
+    lsef, deltaf = lse.reshape(bh, s, 1), delta.reshape(bh, s, 1)
+
+    qb = lambda bh_, i, j: (bh_, i, 0)  # noqa: E731
+    kb = lambda bh_, i, j: (bh_, j, 0)  # noqa: E731
+    row_q = pl.BlockSpec((1, block_q, d), qb)
+    row_k = pl.BlockSpec((1, block_k, d), kb)
+    aux_q = pl.BlockSpec((1, block_q, 1), qb)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[row_q, row_k, row_k, row_q, aux_q, aux_q],
+        out_specs=row_q,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    # dk/dv grid swaps the roles: k-block outer, q-block inner.
+    qb2 = lambda bh_, j, i: (bh_, i, 0)  # noqa: E731
+    kb2 = lambda bh_, j, i: (bh_, j, 0)  # noqa: E731
+    row_q2 = pl.BlockSpec((1, block_q, d), qb2)
+    row_k2 = pl.BlockSpec((1, block_k, d), kb2)
+    aux_q2 = pl.BlockSpec((1, block_q, 1), qb2)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ),
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[row_q2, row_k2, row_k2, row_q2, aux_q2, aux_q2],
+        out_specs=(row_k2, row_k2),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    unflat = lambda x: x.reshape(b, h, s, d)  # noqa: E731
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
 def flash_attention(
@@ -167,16 +366,11 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,  # resolved by flash_attention(); never None here
-) -> jax.Array:
+):
+    """Returns (out [B,H,S,D], lse [B,H,S,1] float32)."""
     b, h, s, d = q.shape
     assert k.shape == v.shape == (b, h, s, d)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(
-            f"sequence length {s} must be divisible by block sizes "
-            f"({block_q}, {block_k})"
-        )
+    block_q, block_k = _resolve_blocks(s, block_q, block_k)
 
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)
@@ -190,16 +384,22 @@ def _flash_forward(
         block_q=block_q,
         block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -207,4 +407,4 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s, 1)
