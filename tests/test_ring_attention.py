@@ -251,3 +251,30 @@ def test_to_zigzag_preserves_batch_sharding():
     back = from_zigzag(z, mesh)
     assert back.sharding.spec == P("dp", None, "sp", None)
     np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_with_flash_chunks_matches_dense(causal):
+    """chunk_impl="flash": the fused Pallas kernel computes each
+    (q-chunk, k-chunk) tile and its (out, lse) merges into the ring's
+    online softmax as (out, lse, 1) — cross-device ring memory plus
+    on-device flash memory, composed."""
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv((1, 2, 256, 32), seed=21 + int(causal))
+    qs, ks, vs = (shard_seq(t, mesh) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, causal=causal, chunk_impl="flash")
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_reference_attention(q, k, v, causal)),
+        atol=3e-5,
+        rtol=1e-5,
+    )
+
+
+def test_ring_flash_chunk_too_small_rejected():
+    from torchsnapshot_tpu.parallel.ring_attention import ring_attention as ra
+
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv((1, 1, 8, 8))  # chunk = 1 per device
+    with pytest.raises(ValueError, match="power-of-two factor"):
+        ra(q, k, v, mesh, chunk_impl="flash")

@@ -20,7 +20,6 @@ TPU-first design notes:
   one jit program.
 """
 
-import math
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -31,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import flash_attention
+from ..ops.attention import flash_attention, resolve_flash_block
 from ..parallel.mesh import shard_pytree
 from ..parallel.ring_attention import ring_attention
 
@@ -186,20 +185,7 @@ def forward(
         k = k.reshape(*k.shape[:2], config.n_heads, head_dim)
         v = v.reshape(*v.shape[:2], config.n_heads, head_dim)
         if config.flash_attention:
-            # Largest power-of-two divisor of the sequence length, capped
-            # at the MXU-friendly 128 (seq lengths like 192 would crash a
-            # bare min(128, S) since 128 does not divide them). Lengths
-            # with tiny power-of-two factors would degenerate into
-            # sub-MXU tiles (S=129 -> 1-row blocks: S^2 scalar kernel
-            # calls, worse than einsum) — reject those outright.
-            block = math.gcd(seq_len, 128)
-            if block < 8:
-                raise ValueError(
-                    f"flash_attention needs a sequence length with a "
-                    f"power-of-two factor >= 8; {seq_len} tiles at "
-                    f"{block} rows. Pad the sequence or use the einsum "
-                    f"path."
-                )
+            block = resolve_flash_block(seq_len)
             attn = flash_attention(
                 q.transpose(0, 2, 1, 3),
                 k.transpose(0, 2, 1, 3),

@@ -58,6 +58,28 @@ def _block_visible(qi, kj, block_q: int, block_k: int):
     return kj * block_k <= qi * block_q + (block_q - 1)
 
 
+def resolve_flash_block(seq_len: int) -> int:
+    """The MXU tiling policy, shared by every flash call site: largest
+    power-of-two divisor of the sequence length, capped at 128; lengths
+    whose factor is below the sublane minimum (8) are rejected — they
+    would tile into sub-MXU scalar-sized blocks, worse than einsum."""
+    import math
+
+    block = math.gcd(seq_len, 128)
+    if block < 8:
+        raise ValueError(
+            f"flash attention needs a sequence length with a power-of-two "
+            f"factor >= 8; {seq_len} tiles at {block} rows. Pad the "
+            f"sequence or use the einsum path."
+        )
+    return block
+
+
+def resolve_interpret() -> bool:
+    """Run the kernel in interpreter mode off-TPU (hermetic CPU tests)."""
+    return jax.default_backend() == "cpu"
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
@@ -227,8 +249,9 @@ def _flash_bwd_dkv_kernel(
 
 
 def _reference_attention(q, k, v, causal):
-    """Differentiable einsum attention — the kernel's numerical spec and
-    the recompute target for the backward pass."""
+    """Differentiable einsum attention — the kernels' numerical spec
+    (forward and backward match it to float tolerance, not bitwise: the
+    tiled kernels reassociate the softmax reductions)."""
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (d**0.5)
     if causal:
@@ -292,11 +315,15 @@ def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
     qf, kf, vf, gf = flat(q), flat(k), flat(v), flat(g)
     lsef, deltaf = lse.reshape(bh, s, 1), delta.reshape(bh, s, 1)
 
-    qb = lambda bh_, i, j: (bh_, i, 0)  # noqa: E731
-    kb = lambda bh_, i, j: (bh_, j, 0)  # noqa: E731
-    row_q = pl.BlockSpec((1, block_q, d), qb)
-    row_k = pl.BlockSpec((1, block_k, d), kb)
-    aux_q = pl.BlockSpec((1, block_q, 1), qb)
+    # Two index maps cover both grids: "block index is grid axis 1" vs
+    # "grid axis 2". dq's grid is (bh, q, k); dk/dv's is (bh, k, q) — the
+    # q-indexed operands ride axis 1 in the first and axis 2 in the
+    # second, and vice versa for k-indexed ones.
+    by_axis1 = lambda bh_, a, b_: (bh_, a, 0)  # noqa: E731
+    by_axis2 = lambda bh_, a, b_: (bh_, b_, 0)  # noqa: E731
+    row_q = pl.BlockSpec((1, block_q, d), by_axis1)
+    row_k = pl.BlockSpec((1, block_k, d), by_axis2)
+    aux_q = pl.BlockSpec((1, block_q, 1), by_axis1)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -311,12 +338,10 @@ def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
         interpret=interpret,
     )(qf, kf, vf, gf, lsef, deltaf)
 
-    # dk/dv grid swaps the roles: k-block outer, q-block inner.
-    qb2 = lambda bh_, j, i: (bh_, i, 0)  # noqa: E731
-    kb2 = lambda bh_, j, i: (bh_, j, 0)  # noqa: E731
-    row_q2 = pl.BlockSpec((1, block_q, d), qb2)
-    row_k2 = pl.BlockSpec((1, block_k, d), kb2)
-    aux_q2 = pl.BlockSpec((1, block_q, 1), qb2)
+    # dk/dv grid swaps the roles: k-block outer (axis 1), q-block inner.
+    row_q2 = pl.BlockSpec((1, block_q, d), by_axis2)
+    row_k2 = pl.BlockSpec((1, block_k, d), by_axis1)
+    aux_q2 = pl.BlockSpec((1, block_q, 1), by_axis2)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -351,7 +376,7 @@ def flash_attention(
 ) -> jax.Array:
     """softmax(QKᵀ/√D)·V without materializing the S×S score matrix."""
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = resolve_interpret()
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 

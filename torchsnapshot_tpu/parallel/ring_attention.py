@@ -131,9 +131,20 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     spec: Optional[P] = None,
+    chunk_impl: str = "einsum",
 ) -> jax.Array:
     """Exact softmax(QKᵀ/√D)·V with Q/K/V sequence-sharded over a mesh
-    axis; K/V slices rotate around the ring via ppermute."""
+    axis; K/V slices rotate around the ring via ppermute.
+
+    ``chunk_impl`` selects the per-chunk attention: ``"einsum"``
+    (default, differentiable) or ``"flash"`` — the fused Pallas kernel
+    per (q-chunk, k-chunk) tile, composing ring (cross-device O(S/n)
+    memory) with flash (on-device O(chunk·D) memory) for long-context
+    *inference*; the flash chunk path exposes no VJP, so differentiate
+    the einsum path for training. A flash chunk's normalized output and
+    log-sum-exp slot into the online-softmax merge as (out, lse, 1)."""
+    if chunk_impl not in ("einsum", "flash"):
+        raise ValueError(f"unknown chunk_impl: {chunk_impl!r}")
     b, h, s, d = q.shape
     n = mesh.shape[axis]
     if s % n:
@@ -143,6 +154,15 @@ def ring_attention(
     chunk = s // n
     scale = 1.0 / (d**0.5)
     spec = _resolve_spec(q, axis, spec)
+    if chunk_impl == "flash":
+        from ..ops.attention import (
+            _flash_forward,
+            resolve_flash_block,
+            resolve_interpret,
+        )
+
+        flash_block = resolve_flash_block(chunk)
+        flash_interpret = resolve_interpret()
 
     def local(qc, kc, vc):
         # qc/kc/vc: this device's local slice — batch/head dims may be
@@ -152,24 +172,43 @@ def ring_attention(
 
         tri = jnp.tril(jnp.ones((chunk, chunk), dtype=bool))
 
+        def chunk_triplet(k_cur, v_cur, causal_chunk: bool):
+            """(o, m, l) of qc attending to this K/V chunk. The flash
+            kernel's (normalized out, lse) is the triple (out, lse, 1):
+            out·e^lse = Σ exp(s)·v and 1·e^lse = Σ exp(s), so the merge
+            recurrence is unchanged."""
+            if chunk_impl == "flash":
+                out, lse = _flash_forward(
+                    qc, k_cur, v_cur, causal_chunk,
+                    flash_block, flash_block, flash_interpret,
+                )
+                return (
+                    out.astype(jnp.float32),
+                    lse,
+                    jnp.ones_like(lse),
+                )
+            return _chunk_attn(
+                qc, k_cur, v_cur, scale, tri if causal_chunk else None
+            )
+
         def accumulate(i, acc, k_cur, v_cur):
             o_run, m_run, l_run = acc
             # After i rotations of send-to-next, this device holds the
             # K/V chunk originally owned by device (my_idx - i) mod n.
             src = (my_idx - i) % n
 
-            def masked(mask):
-                o, m, l = _chunk_attn(qc, k_cur, v_cur, scale, mask)
+            def attend(causal_chunk):
+                o, m, l = chunk_triplet(k_cur, v_cur, causal_chunk)
                 return _merge((o_run, m_run, l_run), o, m, l)
 
             if not causal:
-                return masked(None)
+                return attend(False)
             return jax.lax.cond(
                 src < my_idx,
-                lambda: masked(None),  # fully in the past
+                lambda: attend(False),  # fully in the past
                 lambda: jax.lax.cond(
                     src == my_idx,
-                    lambda: masked(tri),  # diagonal chunk
+                    lambda: attend(True),  # diagonal chunk
                     lambda: (o_run, m_run, l_run),  # future: skip
                 ),
             )
