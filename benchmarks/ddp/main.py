@@ -62,8 +62,21 @@ def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
     begin = time.monotonic()
     Snapshot.take(snap_path, {"model": model}, coord=coord, replicated=["**"])
     elapsed = time.monotonic() - begin
-    if rank == 0:
-        out_queue.put((elapsed, model.total_bytes()))
+
+    # Per-rank bytes actually written — the striping evidence. For
+    # memory:// each process has its own private "bucket", so its store
+    # holds exactly this rank's writes (the payload objects plus, on
+    # rank 0, the metadata document).
+    rank_bytes = None
+    if snap_path.startswith("memory://"):
+        from torchsnapshot_tpu.storage_plugin import _MEMORY_STORES
+
+        root = snap_path[len("memory://") :]
+        store = _MEMORY_STORES.get(root, {})
+        rank_bytes = sum(
+            len(v) for k, v in store.items() if not k.startswith(".snapshot")
+        )
+    out_queue.put((rank, elapsed, model.total_bytes(), rank_bytes))
 
 
 def run(
@@ -90,12 +103,31 @@ def run(
     for p in procs:
         if p.exitcode != 0:
             raise RuntimeError(f"worker failed with exit code {p.exitcode}")
-    elapsed, nbytes = q.get(timeout=10)
-    return {
+    results = [q.get(timeout=10) for _ in range(nprocs)]
+    elapsed = next(e for r, e, _, _ in results if r == 0)
+    nbytes = results[0][2]
+    per_rank = {r: b for r, _, _, b in results if b is not None}
+    out = {
         "nprocs": nprocs,
         "seconds": round(elapsed, 2),
         "GBps": round(nbytes / 1024**3 / elapsed, 3),
     }
+    if per_rank:
+        out["per_rank_written_MB"] = {
+            r: round(b / 1024**2, 1) for r, b in sorted(per_rank.items())
+        }
+        # The striping claim, asserted: replicated values stripe round-
+        # robin, so the busiest rank writes ~1/N of the total (within one
+        # 100 MB parameter of granularity).
+        expect = nbytes / nprocs
+        slack = 100 * 1024 * 1024
+        busiest = max(per_rank.values())
+        if busiest > expect + slack:
+            raise AssertionError(
+                f"striping failed: busiest rank wrote {busiest} bytes, "
+                f"expected ≈{expect:.0f} (±{slack})"
+            )
+    return out
 
 
 def main() -> None:
@@ -112,9 +144,10 @@ def main() -> None:
     args = parser.parse_args()
 
     base_dir = args.work_dir or tempfile.mkdtemp(prefix="tpusnapshot-ddp-")
+    ns = sorted({1, 2, args.nprocs} if args.nprocs >= 2 else {1})
     try:
         results = []
-        for n in (1, args.nprocs):
+        for n in ns:
             res = run(n, args.total_bytes, base_dir, url=args.url)
             results.append(res)
             print(json.dumps(res), file=sys.stderr)
@@ -134,7 +167,7 @@ def main() -> None:
             # Remote snapshots aren't under base_dir; GC them explicitly.
             from torchsnapshot_tpu import Snapshot
 
-            for n in (1, args.nprocs):
+            for n in ns:
                 try:
                     Snapshot(f"{args.url.rstrip('/')}/snap-{n}").delete()
                 except Exception:

@@ -1,0 +1,40 @@
+"""tpusnapshot: TPU-native checkpointing with torchsnapshot capabilities.
+
+Public surface mirrors the reference (torchsnapshot/__init__.py:17-23):
+``Snapshot``, ``Stateful``, ``StateDict``, ``RNGState``, ``__version__`` —
+plus the async-take handle ``PendingSnapshot`` and the ``Coordinator``
+shim for explicit multi-process control.
+"""
+
+from .coord import (
+    Coordinator,
+    DictStore,
+    FileStore,
+    NoOpCoordinator,
+    StoreCoordinator,
+    get_coordinator,
+)
+from .rng_state import RNGState
+from .snapshot import PendingSnapshot, Snapshot
+from .state_dict import StateDict
+from .stateful import AppState, Stateful
+from .utils.train_state import FnStateful, PytreeStateful
+from .version import __version__
+
+__all__ = [
+    "AppState",
+    "Coordinator",
+    "DictStore",
+    "FileStore",
+    "FnStateful",
+    "NoOpCoordinator",
+    "PytreeStateful",
+    "PendingSnapshot",
+    "RNGState",
+    "Snapshot",
+    "StateDict",
+    "Stateful",
+    "StoreCoordinator",
+    "get_coordinator",
+    "__version__",
+]

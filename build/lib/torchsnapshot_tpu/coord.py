@@ -1,0 +1,286 @@
+"""Coordination shim: small object collectives over a KV store.
+
+TPU-native analog of reference torchsnapshot/pg_wrapper.py:13-57. The
+snapshot protocol needs only *tiny* object collectives — key lists, glob
+matches, manifests (kilobytes) — plus barriers; bulk tensor data goes
+process→storage, never process→process (SURVEY §5). So instead of a
+NCCL/gloo process group, the backend is a key-value store:
+
+- ``NoOpCoordinator`` — single-process; every collective degrades to the
+  identity (reference pg_wrapper.py:26-29).
+- ``StoreCoordinator`` — generic collectives over an abstract blocking KV
+  store, with three stores:
+
+  - ``DictStore`` — in-process shared dict (threaded multi-"rank" tests);
+  - ``FileStore`` — a directory on a shared filesystem (multi-process
+    tests, single-node launches);
+  - ``JaxStore`` — the ``jax.distributed`` coordination service (DCN),
+    the production path on multi-host TPU pods.
+
+``get_coordinator()`` picks ``JaxStore`` automatically when
+``jax.distributed`` is initialized, else ``NoOpCoordinator`` — mirroring
+the reference's "degrade gracefully when dist is uninitialized" contract.
+
+Large blobs (> ~1 MB) are chunked through the store transparently, since
+coordination-service values have size limits (SURVEY §7 hard part #3).
+"""
+
+import abc
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_TIMEOUT_S = 300.0
+_CHUNK = 512 * 1024  # chunk size for large values through the KV store
+
+
+class Store(abc.ABC):
+    """A blocking KV store: set once, get blocks until the key exists."""
+
+    @abc.abstractmethod
+    def set(self, key: str, value: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        ...
+
+
+class DictStore(Store):
+    """In-process store shared between threads simulating ranks."""
+
+    def __init__(self) -> None:
+        self._data: Dict[str, bytes] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"Timed out waiting for key: {key}")
+                self._cond.wait(timeout=remaining)
+            return self._data[key]
+
+
+class FileStore(Store):
+    """Directory-backed store for multi-process coordination on one node
+    (or any shared filesystem). Writes are atomic via rename."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, key: str) -> str:
+        safe = key.replace("/", "__")
+        return os.path.join(self.path, safe)
+
+    def set(self, key: str, value: bytes) -> None:
+        target = self._file(key)
+        fd, tmp = tempfile.mkstemp(dir=self.path)
+        with os.fdopen(fd, "wb") as f:
+            f.write(value)
+        os.replace(tmp, target)
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        target = self._file(key)
+        deadline = time.monotonic() + timeout_s
+        delay = 0.001
+        while True:
+            try:
+                with open(target, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"Timed out waiting for key: {key}")
+                time.sleep(delay)
+                delay = min(delay * 2, 0.05)
+
+
+class JaxStore(Store):
+    """The jax.distributed coordination-service KV store (DCN).
+
+    Values are hex-encoded because the service stores strings.
+    """
+
+    def __init__(self) -> None:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; call "
+                "jax.distributed.initialize() first."
+            )
+        self._client = client
+
+    def set(self, key: str, value: bytes) -> None:
+        self._client.key_value_set(key, value.hex())
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        val = self._client.blocking_key_value_get(key, int(timeout_s * 1000))
+        return bytes.fromhex(val)
+
+
+class Coordinator(abc.ABC):
+    """Collective interface used by Snapshot (reference PGWrapper)."""
+
+    @abc.abstractmethod
+    def get_rank(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def get_world_size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        ...
+
+    @abc.abstractmethod
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        ...
+
+
+class NoOpCoordinator(Coordinator):
+    def get_rank(self) -> int:
+        return 0
+
+    def get_world_size(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        pass
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        return [obj]
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        return obj
+
+
+class StoreCoordinator(Coordinator):
+    """Object collectives over a :class:`Store`.
+
+    Every collective consumes one *generation* so keys never collide across
+    successive operations; all processes must issue the same sequence of
+    collectives (same discipline as any process group).
+    """
+
+    def __init__(self, store: Store, rank: int, world_size: int,
+                 timeout_s: float = _DEFAULT_TIMEOUT_S) -> None:
+        self._store = store
+        self._rank = rank
+        self._world = world_size
+        self._gen = 0
+        self._timeout_s = timeout_s
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world
+
+    def _next_gen(self) -> int:
+        self._gen += 1
+        return self._gen
+
+    def _set_chunked(self, key: str, payload: bytes) -> None:
+        if len(payload) <= _CHUNK:
+            self._store.set(key, b"\x00" + payload)
+        else:
+            n = -(-len(payload) // _CHUNK)
+            for i in range(n):
+                self._store.set(f"{key}/part{i}", payload[i * _CHUNK:(i + 1) * _CHUNK])
+            self._store.set(key, b"\x01" + str(n).encode())
+
+    def _get_chunked(self, key: str) -> bytes:
+        head = self._store.get(key, self._timeout_s)
+        if head[:1] == b"\x00":
+            return head[1:]
+        n = int(head[1:].decode())
+        return b"".join(
+            self._store.get(f"{key}/part{i}", self._timeout_s) for i in range(n)
+        )
+
+    def barrier(self) -> None:
+        gen = self._next_gen()
+        self._store.set(f"b/{gen}/{self._rank}", b"1")
+        for r in range(self._world):
+            self._store.get(f"b/{gen}/{r}", self._timeout_s)
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        gen = self._next_gen()
+        self._set_chunked(f"ag/{gen}/{self._rank}", pickle.dumps(obj, protocol=4))
+        return [
+            pickle.loads(self._get_chunked(f"ag/{gen}/{r}"))
+            for r in range(self._world)
+        ]
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        gen = self._next_gen()
+        if self._rank == src:
+            self._set_chunked(f"bc/{gen}", pickle.dumps(obj, protocol=4))
+            return obj
+        return pickle.loads(self._get_chunked(f"bc/{gen}"))
+
+
+# Process-wide singleton: collective key generations must advance
+# monotonically across *all* snapshot operations in a process — a fresh
+# StoreCoordinator per take() would restart at generation 1 and collide
+# with keys already present in the persistent coordination service.
+_default_coordinator: Optional[Coordinator] = None
+_default_coordinator_lock = threading.Lock()
+
+
+def get_coordinator(coord: Optional[Coordinator] = None) -> Coordinator:
+    """Resolve the coordinator: explicit > jax.distributed > single-process.
+
+    Reference analog: PGWrapper's fallback to WORLD / no-op
+    (pg_wrapper.py:24-29). The auto-resolved jax.distributed coordinator is
+    a process-wide singleton so successive snapshot operations never reuse
+    KV keys. Explicitly-passed coordinators are likewise expected to be
+    long-lived (one per process, like a process group).
+    """
+    global _default_coordinator
+    if coord is not None:
+        return coord
+    with _default_coordinator_lock:
+        if _default_coordinator is not None:
+            return _default_coordinator
+        try:
+            import jax
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+        except (ImportError, AttributeError):
+            # jax absent or its internals moved: single-process semantics.
+            client = None
+        if client is None:
+            # jax.distributed not initialized — single-process. Not cached,
+            # so a later jax.distributed.initialize() is still honored
+            # (initialize() must precede the first *multi-process* snapshot
+            # op, as with any process group).
+            return NoOpCoordinator()
+        # jax.distributed IS initialized: failures past this point must
+        # raise, not silently degrade to a world-size-1 coordinator that
+        # would corrupt multi-host snapshots.
+        _default_coordinator = StoreCoordinator(
+            store=JaxStore(),
+            rank=jax.process_index(),
+            world_size=jax.process_count(),
+        )
+        return _default_coordinator

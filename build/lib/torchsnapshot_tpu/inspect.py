@@ -1,0 +1,96 @@
+"""Snapshot inspection CLI.
+
+Usage::
+
+    python -m torchsnapshot_tpu.inspect <snapshot-path> [--rank N] [--raw]
+
+Prints the rank-local view of the manifest: one line per entry with type,
+dtype/shape (arrays), chunk count (sharded arrays), byte size, and
+location. ``--raw`` prints the full rank-prefixed global manifest instead.
+"""
+
+import argparse
+import sys
+
+from .manifest import (
+    ArrayEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    get_available_entries,
+)
+from .serialization import array_nbytes
+from .snapshot import Snapshot
+
+
+def _human(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _describe(path: str, entry) -> str:
+    if isinstance(entry, ShardedArrayEntry):
+        nbytes = array_nbytes(entry.dtype, entry.shape)
+        return (
+            f"{path:60s} ShardedArray {entry.dtype}{tuple(entry.shape)} "
+            f"{_human(nbytes)} in {len(entry.shards)} chunks"
+        )
+    if isinstance(entry, ArrayEntry):
+        nbytes = array_nbytes(entry.dtype, entry.shape)
+        repl = " replicated" if entry.replicated else ""
+        return (
+            f"{path:60s} Array {entry.dtype}{tuple(entry.shape)} "
+            f"{_human(nbytes)}{repl} @ {entry.location}"
+        )
+    if isinstance(entry, ObjectEntry):
+        repl = " replicated" if entry.replicated else ""
+        return f"{path:60s} object{repl} @ {entry.location}"
+    if isinstance(entry, PrimitiveEntry):
+        return f"{path:60s} {entry.ptype} = {entry.readable}"
+    if isinstance(entry, (ListEntry, DictEntry)):
+        return f"{path:60s} <{entry.type}>"
+    return f"{path:60s} {entry.type}"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="torchsnapshot_tpu.inspect")
+    parser.add_argument("path")
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--raw", action="store_true")
+    parser.add_argument(
+        "--delete",
+        action="store_true",
+        help="delete the snapshot (metadata first, then all payloads)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.delete:
+        Snapshot(args.path).delete()
+        print(f"deleted {args.path}")
+        return 0
+
+    manifest = Snapshot(args.path).get_manifest()
+    view = manifest if args.raw else get_available_entries(manifest, args.rank)
+    total = 0
+    counted = set()
+    for path in sorted(view):
+        entry = view[path]
+        print(_describe(path, entry))
+        if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
+            # In --raw mode sharded/replicated entries appear once per
+            # rank; count each logical value once.
+            logical = path.split("/", 1)[1] if args.raw and "/" in path else path
+            if logical not in counted:
+                counted.add(logical)
+                total += array_nbytes(entry.dtype, entry.shape)
+    print(f"\n{len(view)} entries, {_human(total)} of array data")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Interop: migrate between reference torchsnapshot snapshots/statefuls
+and this framework.
+
+Two migration paths for users switching from the reference
+(mary-lau/torchsnapshot):
+
+- :class:`ReferenceSnapshotReader` — read a snapshot **written by the
+  reference library** (YAML ``.snapshot_metadata`` + ``torch_save``
+  payloads; reference manifest.py:14-154, io_preparer.py:196-242) and
+  restore it into JAX statefuls or convert it to this framework's native
+  format.
+- :class:`TorchStateful` — wrap a torch-style stateful (``nn.Module``,
+  optimizer, anything with ``state_dict``/``load_state_dict`` holding CPU
+  ``torch.Tensor`` leaves) so it snapshots/restores through this
+  framework bit-exactly, bfloat16 included.
+
+torch is an optional dependency of this subpackage only; the core
+framework never imports it.
+"""
+
+from .reference_format import ReferenceSnapshotReader
+from .torch_stateful import TorchStateful, numpy_to_torch_tree, torch_to_numpy_tree
+
+__all__ = [
+    "ReferenceSnapshotReader",
+    "TorchStateful",
+    "numpy_to_torch_tree",
+    "torch_to_numpy_tree",
+]

@@ -1,0 +1,79 @@
+"""Bitwise torch.Tensor ⇄ numpy conversion, bfloat16 included.
+
+numpy has no native bfloat16/float8; torch refuses ``Tensor.numpy()`` on
+them. Both directions therefore reinterpret the payload through a
+same-width integer view (``torch.bfloat16`` ⇄ ``int16`` bits ⇄
+``ml_dtypes.bfloat16``), which is exact by construction — no values pass
+through a wider float.
+"""
+
+from typing import Any
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+
+
+def _require_torch() -> Any:
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked into CI
+        raise RuntimeError(
+            "torchsnapshot_tpu.interop requires torch (CPU build is "
+            "sufficient). The core framework does not."
+        ) from e
+    return torch
+
+
+# torch dtypes without a numpy equivalent → (bit-view int dtype, ml_dtypes name)
+_VIA_BITS = {
+    "torch.bfloat16": ("int16", "bfloat16"),
+    "torch.float8_e4m3fn": ("int8", "float8_e4m3fn"),
+    "torch.float8_e5m2": ("int8", "float8_e5m2"),
+}
+
+
+def torch_tensor_to_numpy(tensor: Any) -> np.ndarray:
+    """Bitwise-exact host numpy copy of a torch tensor (any device)."""
+    torch = _require_torch()
+    t = tensor.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    t = t.contiguous()
+    key = str(t.dtype)
+    if key in _VIA_BITS:
+        int_name, ml_name = _VIA_BITS[key]
+        if ml_dtypes is None:  # pragma: no cover
+            raise RuntimeError(f"ml_dtypes is required to convert {key}")
+        bits = t.view(getattr(torch, int_name)).numpy()
+        return bits.view(np.dtype(getattr(ml_dtypes, ml_name))).copy()
+    return t.numpy().copy()
+
+
+def numpy_to_torch_tensor(arr: np.ndarray) -> Any:
+    """Bitwise-exact torch CPU tensor from a numpy array."""
+    torch = _require_torch()
+    # A C-order copy is contiguous and, unlike np.ascontiguousarray,
+    # preserves 0-d shapes (ascontiguousarray promotes 0-d to (1,)).
+    arr = arr.copy(order="C")
+    if ml_dtypes is not None:
+        for torch_name, (int_name, ml_name) in _VIA_BITS.items():
+            if arr.dtype == np.dtype(getattr(ml_dtypes, ml_name)):
+                bits = arr.view(np.dtype(int_name))
+                torch_dtype = getattr(torch, torch_name.split(".", 1)[1])
+                return torch.from_numpy(bits).view(torch_dtype)
+    return torch.from_numpy(arr)
+
+
+def torch_dtype_to_numpy(dtype_str: str) -> np.dtype:
+    """Map a reference manifest dtype string ("torch.float32") to numpy."""
+    name = dtype_str.split(".", 1)[-1]
+    if f"torch.{name}" in _VIA_BITS:
+        if ml_dtypes is None:  # pragma: no cover
+            raise RuntimeError(f"ml_dtypes is required for {dtype_str}")
+        return np.dtype(getattr(ml_dtypes, _VIA_BITS[f"torch.{name}"][1]))
+    aliases = {"half": "float16", "float": "float32", "double": "float64", "long": "int64"}
+    return np.dtype(aliases.get(name, name))

@@ -1,0 +1,404 @@
+"""Reader for snapshots written by the **reference** torchsnapshot.
+
+On-disk format being read (all cited from the reference):
+- ``.snapshot_metadata`` at the snapshot root — a YAML document
+  ``{version, world_size, manifest}`` where manifest maps
+  ``"<rank>/<logical/path>"`` to a tagged-union entry dict
+  (manifest.py:14-154);
+- entry types ``Tensor`` (location/serializer/dtype/shape/replicated),
+  ``ShardedTensor`` (shards: [{offsets, sizes, tensor}]), ``object``
+  (location/serializer/obj_type/replicated), and the containers ``list``/
+  ``dict``/``OrderedDict`` (manifest.py:26-105);
+- payloads are ``torch.save`` blobs, one storage object per leaf, under
+  ``<rank>/…``, ``replicated/…`` or ``sharded/…`` (io_preparer.py:196-242,
+  336-342).
+
+Availability semantics mirror the reference's ``get_available_entries``
+(manifest.py:157-213): sharded entries merge shards across every saving
+rank; replicated entries resolve for any rank; per-rank entries resolve
+only for their owner — with the rank parsed from the full first path
+token, not its first character (the reference's ``int(tokens[0])`` with a
+1-char token breaks for world sizes > 10; SURVEY §7).
+
+This module is read-side interop only — it never imports the reference
+package, and writing reference-format snapshots is out of scope (users
+migrate forward, to :meth:`ReferenceSnapshotReader.convert`).
+"""
+
+import asyncio
+import io
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import yaml
+
+from ..flatten import flatten, inflate
+from ..io_types import IOReq, io_payload
+from ..manifest import DictEntry, Entry, ListEntry, OrderedDictEntry
+from ..stateful import AppState
+from ..storage_plugin import url_to_storage_plugin
+from ._torch_convert import torch_dtype_to_numpy, torch_tensor_to_numpy
+
+logger = logging.getLogger(__name__)
+
+_METADATA_FNAME = ".snapshot_metadata"
+_CONTAINER_TYPES = ("list", "dict", "OrderedDict")
+
+
+class ReferenceSnapshotReader:
+    """Random-access reader over a reference-torchsnapshot snapshot.
+
+    Usage::
+
+        reader = ReferenceSnapshotReader("/path/to/ref_snapshot")
+        weight = reader.read("model/linear.weight")      # numpy, bitwise
+        state = reader.load("model")                     # nested state dict
+        reader.restore(app_state)                        # into JAX statefuls
+        reader.convert("/path/to/native", compression="zlib")
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._storage = None
+        self._metadata: Optional[Dict[str, Any]] = None
+        self._available_cache: Dict[int, Dict[str, Dict[str, Any]]] = {}
+
+    def close(self) -> None:
+        """Release the underlying storage client (idempotent)."""
+        if self._storage is not None:
+            self._storage.close()
+            self._storage = None
+
+    def __enter__(self) -> "ReferenceSnapshotReader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- metadata
+
+    @property
+    def metadata(self) -> Dict[str, Any]:
+        if self._metadata is None:
+            raw = self._read_blob(_METADATA_FNAME)
+            doc = yaml.safe_load(raw.decode("utf-8"))
+            if not isinstance(doc, dict) or "manifest" not in doc:
+                raise RuntimeError(
+                    f"{self.path}/{_METADATA_FNAME} is not a torchsnapshot "
+                    f"metadata document."
+                )
+            self._metadata = doc
+        return self._metadata
+
+    @property
+    def world_size(self) -> int:
+        return int(self.metadata.get("world_size", 1))
+
+    def manifest(self) -> Dict[str, Dict[str, Any]]:
+        """The raw rank-prefixed manifest, as saved."""
+        return dict(self.metadata["manifest"])
+
+    def available_entries(self, rank: int = 0) -> Dict[str, Dict[str, Any]]:
+        """The rank-local view: logical path → entry dict (rank prefix
+        stripped, sharded entries merged across saving ranks)."""
+        if rank in self._available_cache:
+            return dict(self._available_cache[rank])
+        grouped: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
+        for full_path, entry in self.manifest().items():
+            rank_token, _, logical = full_path.partition("/")
+            try:
+                src_rank = int(rank_token)
+            except ValueError:
+                continue  # not a rank-prefixed path; nothing else exists
+            grouped.setdefault(logical, []).append((src_rank, entry))
+
+        available: Dict[str, Dict[str, Any]] = {}
+        for logical, candidates in grouped.items():
+            first = candidates[0][1]
+            typ = first.get("type")
+            if typ == "ShardedTensor":
+                merged: List[Dict[str, Any]] = []
+                seen = set()
+                for _, entry in candidates:
+                    for shard in entry.get("shards", []):
+                        key = tuple(shard["offsets"])
+                        if key not in seen:
+                            seen.add(key)
+                            merged.append(shard)
+                merged.sort(key=lambda s: tuple(s["offsets"]))
+                available[logical] = {"type": "ShardedTensor", "shards": merged}
+                continue
+            for src_rank, entry in candidates:
+                if entry.get("replicated") or src_rank == rank or (
+                    typ in _CONTAINER_TYPES and src_rank == candidates[0][0]
+                ):
+                    available[logical] = entry
+                    break
+        self._available_cache[rank] = available
+        return dict(available)
+
+    # ----------------------------------------------------------------- reads
+
+    def read(self, logical_path: str, rank: int = 0) -> Any:
+        """Read one leaf (tensor → numpy, object → unpickled object)."""
+        available = self.available_entries(rank)
+        if logical_path not in available:
+            preview = ", ".join(sorted(available)[:10])
+            raise KeyError(
+                f'"{logical_path}" not in the reference snapshot for rank '
+                f"{rank}. Available paths include: {preview}"
+            )
+        return self._read_entry(available[logical_path])
+
+    def load(self, prefix: str = "", rank: int = 0) -> Any:
+        """Read the subtree under ``prefix`` as a nested state dict with
+        numpy/object leaves (e.g. ``load("model")``; ``load("")`` loads the
+        whole app state keyed by stateful)."""
+        available = self.available_entries(rank)
+        under = {
+            p: e
+            for p, e in available.items()
+            if not prefix or p == prefix or p.startswith(prefix + "/")
+        }
+        if not under:
+            raise KeyError(f'No entries under "{prefix}" for rank {rank}.')
+        containers: Dict[str, Entry] = {}
+        flattened: Dict[str, Any] = {}
+        for p, e in under.items():
+            native = _container_entry(e)
+            if native is not None:
+                containers[p] = native
+            else:
+                flattened[p] = self._read_entry(e)
+        if not prefix:
+            # Top level has no container entry; inflate each stateful key.
+            top_keys = sorted({p.split("/", 1)[0] for p in under})
+            return {k: self._inflate_key(k, containers, flattened) for k in top_keys}
+        return self._inflate_key(prefix, containers, flattened)
+
+    @staticmethod
+    def _inflate_key(
+        prefix: str, containers: Dict[str, Entry], flattened: Dict[str, Any]
+    ) -> Any:
+        sub_c = {
+            p: e
+            for p, e in containers.items()
+            if p == prefix or p.startswith(prefix + "/")
+        }
+        sub_f = {
+            p: v
+            for p, v in flattened.items()
+            if p == prefix or p.startswith(prefix + "/")
+        }
+        if not sub_c and len(sub_f) == 1 and prefix in sub_f:
+            return sub_f[prefix]
+        return inflate(sub_c, sub_f, prefix=prefix)
+
+    # --------------------------------------------------------------- restore
+
+    def restore(self, app_state: AppState, rank: int = 0) -> None:
+        """Restore ``app_state`` in place from the reference snapshot.
+
+        Template-driven like the native restore (reference
+        snapshot.py:374-381): each stateful's ``state_dict()`` supplies
+        structure and placement; ``jax.Array`` templates receive the saved
+        value ``device_put`` with their own sharding, numpy templates
+        receive numpy. Saved and template dtypes must match — migration
+        must not silently cast.
+
+        Single-process by design: migration off a reference snapshot is an
+        offline step, not a hot path.
+        """
+        import jax
+
+        available = self.available_entries(rank)
+        for key in sorted(app_state.keys()):
+            stateful = app_state[key]
+            template_sd = stateful.state_dict()
+            container_manifest, flattened = flatten(template_sd, prefix=key)
+            for logical_path, template in flattened.items():
+                if logical_path not in available:
+                    raise RuntimeError(
+                        f'No entry for "{logical_path}" (rank {rank}) in the '
+                        f"reference snapshot (world_size="
+                        f"{self.world_size}). Per-rank values resolve only "
+                        f"for their saving rank; pass rank=<owner>."
+                    )
+                value = self._read_entry(available[logical_path])
+                flattened[logical_path] = _place_like(value, template, logical_path, jax)
+            new_sd = inflate(container_manifest, flattened, prefix=key)
+            stateful.load_state_dict(new_sd)
+
+    def convert(self, dest_path: str, rank: int = 0, **take_kwargs: Any) -> Any:
+        """Rewrite the snapshot into this framework's native format.
+
+        Returns the native :class:`~torchsnapshot_tpu.Snapshot` handle.
+        Single-process: sharded tensors are assembled dense and re-saved
+        (they re-shard freely on native restore); replicated values are
+        carried once. Per-rank values belonging to *other* ranks cannot be
+        captured by a single-process convert — their presence raises, with
+        the offending paths listed, rather than silently dropping state.
+        """
+        from ..snapshot import Snapshot
+        from ..utils.train_state import PytreeStateful
+
+        foreign = self._foreign_per_rank_paths(rank)
+        if foreign:
+            raise RuntimeError(
+                f"convert() runs single-process but the snapshot holds "
+                f"per-rank values owned by other ranks: "
+                f"{', '.join(sorted(foreign)[:10])}. Convert each rank "
+                f"separately (rank=<owner>) or restore+retake under the "
+                f"original world size."
+            )
+        tree = self.load("", rank=rank)
+        # Dict subclasses (e.g. the reference's pickled StateDict) flatten
+        # as leaves; normalize to plain containers so converted state lands
+        # leaf-per-object in the native layout.
+        app_state = {key: PytreeStateful(_plainify(sd)) for key, sd in tree.items()}
+        return Snapshot.take(dest_path, app_state, **take_kwargs)
+
+    def _foreign_per_rank_paths(self, rank: int) -> List[str]:
+        foreign = []
+        for full_path, entry in self.manifest().items():
+            rank_token, _, logical = full_path.partition("/")
+            try:
+                src_rank = int(rank_token)
+            except ValueError:
+                continue
+            if src_rank == rank or entry.get("replicated"):
+                continue
+            if entry.get("type") in ("ShardedTensor",) + _CONTAINER_TYPES:
+                continue
+            foreign.append(logical)
+        return foreign
+
+    # -------------------------------------------------------------- payloads
+
+    def _read_entry(self, entry: Dict[str, Any]) -> Any:
+        typ = entry.get("type")
+        if typ == "Tensor":
+            return self._read_tensor(entry)
+        if typ == "ShardedTensor":
+            return self._read_sharded(entry)
+        if typ == "object":
+            return self._torch_load(self._read_blob(entry["location"]))
+        raise RuntimeError(f"Unrecognized reference entry type: {typ!r}")
+
+    def _read_tensor(self, entry: Dict[str, Any]) -> np.ndarray:
+        if entry.get("serializer") != "torch_save":
+            raise RuntimeError(
+                f"Unsupported serializer {entry.get('serializer')!r} "
+                f"(reference io_preparer.py always writes torch_save)."
+            )
+        tensor = self._torch_load(self._read_blob(entry["location"]))
+        arr = torch_tensor_to_numpy(tensor)
+        expected = torch_dtype_to_numpy(entry["dtype"])
+        if arr.dtype != expected or list(arr.shape) != list(entry["shape"]):
+            raise RuntimeError(
+                f"Payload at {entry['location']} decodes as "
+                f"{arr.dtype}{list(arr.shape)} but the manifest records "
+                f"{expected}{entry['shape']} — corrupt or tampered snapshot."
+            )
+        return arr
+
+    def _read_sharded(self, entry: Dict[str, Any]) -> np.ndarray:
+        shards = entry["shards"]
+        if not shards:
+            raise RuntimeError("ShardedTensor entry with no shards.")
+        ndim = len(shards[0]["offsets"])
+        global_shape = [
+            max(s["offsets"][d] + s["sizes"][d] for s in shards)
+            for d in range(ndim)
+        ]
+        dtype = torch_dtype_to_numpy(shards[0]["tensor"]["dtype"])
+        out = np.zeros(global_shape, dtype=dtype)
+        for shard in shards:
+            sub = self._read_tensor(shard["tensor"])
+            sel = tuple(
+                slice(o, o + s) for o, s in zip(shard["offsets"], shard["sizes"])
+            )
+            if list(sub.shape) != list(shard["sizes"]):
+                sub = sub.reshape(shard["sizes"])
+            out[sel] = sub
+        return out
+
+    @staticmethod
+    def _torch_load(blob: bytes) -> Any:
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "Reading reference snapshots requires torch (CPU build)."
+            ) from e
+        return torch.load(io.BytesIO(blob), map_location="cpu", weights_only=False)
+
+    def _read_blob(self, rel_path: str) -> bytes:
+        # One storage client for the reader's lifetime (a per-read client
+        # would redo auth/session setup for every leaf on gs:// / s3://);
+        # release it with close() or the context manager.
+        if self._storage is None:
+            self._storage = url_to_storage_plugin(self.path)
+        req = IOReq(path=rel_path)
+        asyncio.run(self._storage.read(req))
+        return bytes(io_payload(req))
+
+
+def _plainify(tree: Any) -> Any:
+    """Normalize container subclasses to plain dict/OrderedDict/list."""
+    from collections import OrderedDict
+
+    if isinstance(tree, OrderedDict):
+        return OrderedDict((k, _plainify(v)) for k, v in tree.items())
+    if isinstance(tree, dict):
+        return {k: _plainify(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_plainify(v) for v in tree]
+    return tree
+
+
+def _container_entry(entry: Dict[str, Any]) -> Optional[Entry]:
+    typ = entry.get("type")
+    if typ == "list":
+        return ListEntry()
+    if typ == "OrderedDict":
+        return OrderedDictEntry(keys=list(entry.get("keys", [])))
+    if typ == "dict":
+        return DictEntry(keys=list(entry.get("keys", [])))
+    return None
+
+
+def _place_like(value: Any, template: Any, path: str, jax: Any) -> Any:
+    """Fit a decoded value to a restore template (placement, not casting)."""
+    if isinstance(template, jax.Array):
+        if not isinstance(value, np.ndarray):
+            raise RuntimeError(
+                f'"{path}": template is a jax.Array but the snapshot holds '
+                f"a {type(value).__name__}."
+            )
+        if np.dtype(template.dtype) != value.dtype:
+            raise RuntimeError(
+                f'"{path}": dtype mismatch (snapshot {value.dtype}, '
+                f"template {template.dtype}). Cast the template instead — "
+                f"migration does not silently convert."
+            )
+        if tuple(template.shape) != tuple(value.shape):
+            raise RuntimeError(
+                f'"{path}": shape mismatch (snapshot {list(value.shape)}, '
+                f"template {list(template.shape)})."
+            )
+        return jax.device_put(value, template.sharding)
+    if isinstance(template, np.ndarray):
+        if not isinstance(value, np.ndarray):
+            raise RuntimeError(
+                f'"{path}": template is a numpy array but the snapshot '
+                f"holds a {type(value).__name__}."
+            )
+        if template.dtype != value.dtype or template.shape != value.shape:
+            raise RuntimeError(
+                f'"{path}": snapshot holds {value.dtype}{list(value.shape)}, '
+                f"template expects {template.dtype}{list(template.shape)}."
+            )
+        return value
+    return value

@@ -1,0 +1,120 @@
+"""TorchStateful: persist torch-style statefuls through this framework.
+
+A migration bridge for reference users whose training still holds torch
+objects (``nn.Module``, optimizers — anything satisfying the Stateful
+protocol, reference stateful.py:13-22): ``state_dict()`` tensors are
+converted to bitwise-identical numpy arrays on save (so they route
+through the framework's array path — raw payload bytes, checksums,
+random access), and poured back into torch tensors **in place** on
+restore, mirroring the reference's in-place tensor restore
+(io_preparer.py:230-234).
+"""
+
+from collections import OrderedDict
+from typing import Any, Dict
+
+import numpy as np
+
+from ._torch_convert import numpy_to_torch_tensor, torch_tensor_to_numpy
+
+
+def _is_torch_tensor(obj: Any) -> bool:
+    try:
+        import torch
+    except ImportError:  # pragma: no cover
+        return False
+    return isinstance(obj, torch.Tensor)
+
+
+def torch_to_numpy_tree(tree: Any) -> Any:
+    """Recursively convert torch.Tensor leaves to numpy (bitwise)."""
+    if _is_torch_tensor(tree):
+        return torch_tensor_to_numpy(tree)
+    if isinstance(tree, OrderedDict):
+        return OrderedDict((k, torch_to_numpy_tree(v)) for k, v in tree.items())
+    if isinstance(tree, dict):
+        return {k: torch_to_numpy_tree(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(torch_to_numpy_tree(v) for v in tree)
+    return tree
+
+
+def numpy_to_torch_tree(tree: Any, template: Any = None, _path: str = "") -> Any:
+    """Recursively convert numpy leaves back to torch tensors.
+
+    With a ``template`` (the in-memory torch state dict), tensors are
+    written **in place** via ``Tensor.copy_`` — preserving requires_grad,
+    device, and aliasing exactly as the reference does; without one, fresh
+    CPU tensors are created.
+    """
+    if _is_torch_tensor(template):
+        if not isinstance(tree, np.ndarray):
+            raise RuntimeError(
+                f'"{_path}": template holds a torch.Tensor but the snapshot '
+                f"value is a {type(tree).__name__}."
+            )
+        if tuple(template.shape) != tuple(tree.shape):
+            raise RuntimeError(
+                f'"{_path}": shape mismatch (snapshot {list(tree.shape)}, '
+                f"template {list(template.shape)})."
+            )
+        restored = numpy_to_torch_tensor(tree)
+        if restored.dtype != template.dtype:
+            raise RuntimeError(
+                f'"{_path}": dtype mismatch (snapshot {restored.dtype}, '
+                f"template {template.dtype}). Tensor.copy_ would silently "
+                f"cast; cast the template instead — migration does not "
+                f"silently convert."
+            )
+        template.detach().copy_(restored)
+        return template
+    if isinstance(tree, np.ndarray):
+        # Absent or non-tensor template: produce a fresh CPU tensor —
+        # never leak numpy leaves into a tree handed to torch's
+        # load_state_dict.
+        return numpy_to_torch_tensor(tree)
+    if isinstance(tree, OrderedDict):
+        return OrderedDict(
+            (k, numpy_to_torch_tree(v, _child(template, k), f"{_path}/{k}"))
+            for k, v in tree.items()
+        )
+    if isinstance(tree, dict):
+        return {
+            k: numpy_to_torch_tree(v, _child(template, k), f"{_path}/{k}")
+            for k, v in tree.items()
+        }
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(
+            numpy_to_torch_tree(v, _child(template, i), f"{_path}/{i}")
+            for i, v in enumerate(tree)
+        )
+    return tree
+
+
+def _child(template: Any, key: Any) -> Any:
+    if isinstance(template, dict):
+        return template.get(key)
+    if isinstance(template, (list, tuple)):
+        return template[key] if isinstance(key, int) and key < len(template) else None
+    return None
+
+
+class TorchStateful:
+    """Adapter placing a torch stateful into this framework's app state::
+
+        model = torch.nn.Linear(8, 4)
+        Snapshot.take(path, {"model": TorchStateful(model)})
+        ...
+        Snapshot(path).restore({"model": TorchStateful(model)})  # in place
+    """
+
+    def __init__(self, obj: Any) -> None:
+        self.obj = obj
+
+    def state_dict(self) -> Dict[str, Any]:
+        return torch_to_numpy_tree(self.obj.state_dict())
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        template = self.obj.state_dict()
+        restored = numpy_to_torch_tree(state_dict, template)
+        self.obj.load_state_dict(restored)

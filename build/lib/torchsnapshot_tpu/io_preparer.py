@@ -1,0 +1,790 @@
+"""IO preparers: map values ⇄ manifest entries + write/read requests.
+
+TPU-native analog of reference torchsnapshot/io_preparer.py:37-401. Three
+value classes:
+
+- **dense arrays** (``numpy.ndarray``, fully-replicated or single-device
+  ``jax.Array``) → ``ArrayEntry`` + one write of raw payload bytes;
+- **sharded arrays** (``jax.Array`` partitioned over a mesh) →
+  ``ShardedArrayEntry``; every addressable shard with ``replica_id == 0``
+  is persisted by the process that owns it (this generalizes the
+  reference's ShardedTensor handling, which has no replica dimension —
+  SURVEY §7 "hard parts" #1), subdivided into ≤ ``MAX_CHUNK_SIZE_BYTES``
+  chunks (reference io_preparer.py:38,40-72);
+- **objects** (anything else picklable) → ``ObjectEntry`` (reference
+  io_preparer.py:290-323), with small scalars inlined into the manifest as
+  ``PrimitiveEntry`` (beyond parity — the reference writes one storage
+  object per scalar).
+
+Staging performs the HBM→host copy inside a thread executor; for
+unsubdivided shards the async device→host copy is kicked off at prepare
+time (``copy_to_host_async``) so transfers overlap with scheduling —
+the TPU analog of the reference's CUDA-stream staging thread pool
+(io_preparer.py:199-210).
+
+Restore routes *all* array entries — dense or sharded — through a single
+:class:`ArrayRestorePlan`, which computes the overlap of saved chunks with
+the *target sharding's* addressable shards (``resharding.py``), reads only
+the needed chunks (with ranged reads for contiguous overlaps), assembles
+per-device host buffers, and builds the result with
+``jax.make_array_from_single_device_arrays``. Elastic restore onto a
+different mesh/pod shape is therefore the same code path as same-sharding
+restore (reference analog: resharding.py:135-199 + io_preparer.py:113-163).
+"""
+
+import asyncio
+import logging
+import os
+import threading
+from concurrent.futures import Executor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .ops.transfer import (
+    chunked_device_put,
+    device_clone,
+    parallel_device_get,
+    should_chunk_h2d,
+    should_chunk_transfer,
+)
+from .manifest import (
+    ArrayEntry,
+    Entry,
+    ObjectEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+)
+from .resharding import (
+    Overlap,
+    compute_overlap,
+    contiguous_byte_range,
+    index_to_offsets_sizes,
+    subdivide,
+)
+from .serialization import (
+    ARRAY_SERIALIZER,
+    OBJECT_SERIALIZER,
+    bytes_to_object,
+    compress_payload,
+    compute_checksum,
+    decompress_payload,
+    dtype_to_str,
+    object_to_bytes,
+    str_to_dtype,
+    verify_checksum,
+)
+
+logger = logging.getLogger(__name__)
+
+# Reference: io_preparer.py:38 (512 MB max shard chunk).
+MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
+
+_PRIMITIVE_TYPES = (int, float, bool, str, complex, type(None))
+
+
+def get_storage_path(rank: int, logical_path: str, replicated: bool) -> str:
+    """Reference analog: io_preparer.py:336-342."""
+    if replicated:
+        return f"replicated/{logical_path}"
+    return f"{rank}/{logical_path}"
+
+
+def chunk_location(logical_path: str, offsets: List[int]) -> str:
+    suffix = "_".join(str(o) for o in offsets)
+    return f"sharded/{logical_path}_{suffix}" if suffix else f"sharded/{logical_path}_0"
+
+
+def _is_jax_array(obj: Any) -> bool:
+    return isinstance(obj, jax.Array)
+
+
+def _is_prng_key_array(obj: Any) -> bool:
+    return _is_jax_array(obj) and jax.dtypes.issubdtype(
+        obj.dtype, jax.dtypes.prng_key
+    )
+
+
+def _is_partitioned(arr: jax.Array) -> bool:
+    """True if the array's data is split across devices (vs replicated)."""
+    return not arr.is_fully_replicated
+
+
+# Chunked-transfer + clone primitives live in ops/transfer.py; private
+# aliases keep this module's call sites short.
+_should_chunk_transfer = should_chunk_transfer
+_parallel_device_get = parallel_device_get
+
+
+class ArrayBufferStager(BufferStager):
+    """Stages a device (or host) array into raw payload bytes.
+
+    ``data`` is a single-device ``jax.Array`` (a shard's ``.data``) or a
+    ``numpy.ndarray``. When ``chunk_slices`` is given, only that sub-box is
+    staged (used when a shard is subdivided): the slice executes on device
+    so only chunk-sized host memory is allocated.
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        chunk_slices: Optional[Tuple[slice, ...]] = None,
+        nbytes: Optional[int] = None,
+        entry: Optional[ArrayEntry] = None,
+        compression: Optional[str] = None,
+        eager_host_copy: bool = True,
+    ) -> None:
+        self._data = data
+        self._chunk_slices = chunk_slices
+        self._compression = compression
+        self._entry = entry  # back-patched with the payload checksum
+        self._owns_data = False  # True once rebound to a private copy
+        if nbytes is None:
+            nbytes = int(np.dtype(data.dtype).itemsize * np.prod(data.shape))
+        self._nbytes = nbytes
+        if (
+            eager_host_copy
+            and _is_jax_array(data)
+            and chunk_slices is None
+            and not _should_chunk_transfer(data)
+        ):
+            # Small arrays: start the whole-array async copy now so the
+            # transfer overlaps with scheduling. Large arrays skip this —
+            # they stage via parallel chunked transfers instead, and a
+            # prepare-time whole-array copy would occupy the link with a
+            # slow single stream. Async takes pass eager_host_copy=False:
+            # a device-staged cut rebinds stagers to on-device clones, and
+            # a transfer started on the original would never be consumed.
+            try:
+                data.copy_to_host_async()
+            except Exception:  # pragma: no cover - platform-dependent
+                pass
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        loop = asyncio.get_running_loop()
+        if executor is None:
+            return self._stage_sync()
+        return await loop.run_in_executor(executor, self._stage_sync)
+
+    def _stage_sync(self) -> BufferType:
+        data = self._data
+        if self._chunk_slices is not None:
+            data = data[self._chunk_slices]
+        if _should_chunk_transfer(data):
+            host = _parallel_device_get(data)
+        else:
+            host = np.asarray(data)  # D2H for jax arrays; no-op for numpy
+        host = np.ascontiguousarray(host)
+        if (
+            isinstance(self._data, np.ndarray)
+            and not self._owns_data
+            and np.shares_memory(host, self._data)
+        ):
+            # User-owned mutable host memory: copy so the staged buffer is
+            # a consistent cut (jax.Arrays are immutable — no copy needed).
+            host = host.copy()
+        # Drop the source reference: once the payload is on host, the
+        # device buffer (ours after a device-staged async take, or the
+        # caller's) no longer needs to be pinned by this stager.
+        self._data = None
+        # Reinterpret as raw bytes: ml_dtypes dtypes (bfloat16, float8_*)
+        # don't export the buffer protocol directly, but a uint8 view does,
+        # and it is zero-copy.
+        payload = memoryview(host.reshape(-1).view(np.uint8))
+        if self._compression is not None:
+            payload = compress_payload(payload, self._compression)
+            if self._entry is not None:
+                self._entry.compression = self._compression
+        if self._entry is not None:
+            # The checksum reaches the persisted metadata because staging
+            # always precedes the manifest consolidation: sync takes write
+            # (hence stage) before the manifest all-gather; async takes
+            # serialize each rank's manifest into its completion marker
+            # only after execute_write_reqs finishes (snapshot.py _drain) —
+            # staging may run entirely in that background drain under a
+            # device-staged cut.
+            self._entry.checksum = compute_checksum(payload)
+        return payload
+
+    def get_staging_cost_bytes(self) -> int:
+        return self._nbytes
+
+
+def device_clone_write_reqs(write_reqs: List[WriteReq]) -> bool:
+    """Rebind every array stager to a private on-device copy of its data.
+
+    The consistent-cut primitive behind device-staged async snapshots: an
+    HBM→HBM copy runs at memory bandwidth (orders of magnitude faster than
+    device→host), so cloning the checkpoint state on device and draining
+    the device→host staging in the background reduces the training stall
+    from "one full D2H of the app state" to "one HBM copy". The clones own
+    their buffers, so a subsequent training step that donates/deletes the
+    source arrays (jit donation) cannot invalidate the snapshot.
+
+    Host-side numpy data is copied on host (it is mutable user memory).
+    Returns False — with all partial clones released — if the device ran
+    out of memory; the caller falls back to host staging.
+    """
+    sources: Dict[int, Any] = {}
+    rebinds: List[Tuple[ArrayBufferStager, int]] = []
+    for wr in write_reqs:
+        stager = wr.buffer_stager
+        if not isinstance(stager, ArrayBufferStager) or stager._data is None:
+            continue
+        data = stager._data
+        if _is_jax_array(data):
+            sources.setdefault(id(data), data)
+            rebinds.append((stager, id(data)))
+        elif isinstance(data, np.ndarray):
+            stager._data = np.array(data, copy=True)
+            stager._owns_data = True
+    order = list(sources)
+    clones = device_clone([sources[k] for k in order])
+    if clones is None:
+        logger.warning(
+            "Device-staged snapshot does not fit in device memory; "
+            "falling back to host staging."
+        )
+        return False
+    clone_by_key = dict(zip(order, clones))
+    for stager, key in rebinds:
+        stager._data = clone_by_key[key]
+        stager._owns_data = True
+    return True
+
+
+class ObjectBufferStager(BufferStager):
+    def __init__(
+        self,
+        obj: Any,
+        entry: Optional[ObjectEntry] = None,
+        compression: Optional[str] = None,
+    ) -> None:
+        # Objects are small (counters, RNG states, dataloader cursors);
+        # pickle eagerly so the staging cost is exact. Compression and
+        # checksum are deferred to stage time: non-owner ranks of a
+        # replicated object drop their write request without staging, so
+        # they never pay those costs (their manifest entry legitimately
+        # carries checksum/compression = None; the restore path prefers
+        # the stripe owner's checksum-bearing entry).
+        self._buf: BufferType = object_to_bytes(obj)
+        self._entry = entry
+        self._compression = compression
+        self._staged = False
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        if not self._staged:
+            self._staged = True
+            if self._compression is not None:
+                self._buf = compress_payload(self._buf, self._compression)
+                if self._entry is not None:
+                    self._entry.compression = self._compression
+            if self._entry is not None:
+                self._entry.checksum = compute_checksum(self._buf)
+        return self._buf
+
+    def get_staging_cost_bytes(self) -> int:
+        return len(self._buf)
+
+
+class ObjectBufferConsumer(BufferConsumer):
+    """Materializes a pickled object and hands it back via callback
+    (reference io_preparer.py:290-304: objects cannot be restored in place).
+    """
+
+    def __init__(
+        self,
+        callback: Callable[[Any], None],
+        size_hint: int = 1 << 20,
+        checksum: Optional[str] = None,
+        compression: Optional[str] = None,
+    ):
+        self._callback = callback
+        self._size_hint = size_hint
+        self._checksum = checksum
+        self._compression = compression
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        def _load() -> Any:
+            verify_checksum(buf, self._checksum)
+            raw = (
+                decompress_payload(buf, self._compression)
+                if self._compression is not None
+                else buf
+            )
+            return bytes_to_object(raw)
+
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            obj = await loop.run_in_executor(executor, _load)
+        else:
+            obj = _load()
+        self._callback(obj)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self._size_hint
+
+
+class _TargetRegion:
+    """One distinct region of the global array needed on restore, with the
+    devices that need it (replicas share one host buffer)."""
+
+    def __init__(self, offsets: List[int], sizes: List[int], dtype: np.dtype):
+        self.offsets = offsets
+        self.sizes = sizes
+        self.devices: List[Any] = []
+        self.buffer = np.empty(sizes, dtype=dtype)
+
+
+class _ChunkCopyConsumer(BufferConsumer):
+    """Consumes one saved chunk's payload (possibly a ranged read) and
+    scatters it into the overlapping target-region buffers."""
+
+    def __init__(
+        self,
+        view_shape: List[int],
+        dtype: np.dtype,
+        copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Tuple[slice, ...]]],
+        checksum: Optional[str] = None,
+        compression: Optional[str] = None,
+        on_done: Optional[Callable[[], None]] = None,
+    ) -> None:
+        # copies: (region, region_slices, view_slices)
+        self._view_shape = view_shape
+        self._dtype = dtype
+        self._copies = copies
+        self._checksum = checksum
+        self._compression = compression
+        self._on_done = on_done
+        self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        def _copy() -> None:
+            verify_checksum(buf, self._checksum)
+            if self._compression is not None:
+                buf_raw = decompress_payload(buf, self._compression)
+            else:
+                buf_raw = buf
+            view = np.frombuffer(buf_raw, dtype=self._dtype).reshape(
+                self._view_shape
+            )
+            for region, region_slices, view_slices in self._copies:
+                if (
+                    len(self._copies) == 1
+                    and view.shape == region.buffer.shape
+                    and all(
+                        sl.start == 0 and sl.stop == dim
+                        for sl, dim in zip(region_slices, region.buffer.shape)
+                    )
+                    and all(
+                        sl.start == 0 and sl.stop == dim
+                        for sl, dim in zip(view_slices, view.shape)
+                    )
+                ):
+                    # The chunk exactly covers this region: adopt the
+                    # zero-copy view instead of memcpy-ing into the
+                    # preallocated buffer (np.frombuffer views are
+                    # read-only, which device_put accepts).
+                    region.buffer = view
+                else:
+                    region.buffer[region_slices] = view[view_slices]
+
+        def _copy_and_signal() -> None:
+            _copy()
+            # Runs in the executor thread: a finalize triggered here (host→
+            # device assembly) overlaps with reads still in flight instead
+            # of blocking the event loop.
+            if self._on_done is not None:
+                self._on_done()
+
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(executor, _copy_and_signal)
+        else:
+            _copy_and_signal()
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self._cost
+
+
+class ArrayRestorePlan:
+    """Plans and finalizes the restore of one array entry into a template.
+
+    The template supplies the target placement: a ``jax.Array`` template's
+    sharding decides which global regions land on which local devices; a
+    numpy/None template restores the full array on host.
+    """
+
+    def __init__(self, entry: Entry, template: Any, callback: Callable[[Any], None]):
+        if isinstance(entry, ShardedArrayEntry):
+            dtype_name, shape = entry.dtype, list(entry.shape)
+            chunks = [
+                (
+                    list(s.offsets),
+                    list(s.sizes),
+                    s.array.location,
+                    s.array.checksum,
+                    s.array.compression,
+                )
+                for s in entry.shards
+            ]
+        elif isinstance(entry, ArrayEntry):
+            dtype_name, shape = entry.dtype, list(entry.shape)
+            chunks = [
+                (
+                    [0] * len(shape),
+                    list(shape),
+                    entry.location,
+                    entry.checksum,
+                    entry.compression,
+                )
+            ]
+        else:
+            raise TypeError(f"Not an array entry: {type(entry)}")
+        self._entry = entry
+        self._callback = callback
+        self._dtype = str_to_dtype(dtype_name)
+        self._shape = shape
+        self._prng_impl = getattr(entry, "prng_impl", None)
+
+        if (
+            self._prng_impl is not None
+            and _is_jax_array(template)
+            and _is_prng_key_array(template)
+        ):
+            # Saved payload is uint32 key data (trailing impl dim). The key
+            # data view shares the keys' device layout, so use it as the
+            # placement template and re-wrap after assembly.
+            template = jax.random.key_data(template)
+        self._template_is_jax = _is_jax_array(template) and not isinstance(
+            template, np.ndarray
+        )
+        self._sharding = None
+        regions: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], _TargetRegion] = {}
+        if self._template_is_jax:
+            if list(template.shape) != shape:
+                raise RuntimeError(
+                    f"Cannot restore array of shape {shape} into a template "
+                    f"of shape {list(template.shape)}. Shapes must match; "
+                    f"resharding (different mesh/partitioning) is supported, "
+                    f"reshaping is not."
+                )
+            self._sharding = template.sharding
+            for shard in template.addressable_shards:
+                off, sz = index_to_offsets_sizes(shard.index, shape)
+                key = (tuple(off), tuple(sz))
+                if key not in regions:
+                    regions[key] = _TargetRegion(off, sz, self._dtype)
+                regions[key].devices.append(shard.device)
+        else:
+            if template is not None and hasattr(template, "shape"):
+                if list(template.shape) != shape and self._prng_impl is None:
+                    raise RuntimeError(
+                        f"Cannot restore array of shape {shape} into a template "
+                        f"of shape {list(template.shape)}."
+                    )
+            off = [0] * len(shape)
+            regions[(tuple(off), tuple(shape))] = _TargetRegion(off, shape, self._dtype)
+        self._regions = list(regions.values())
+        self._chunks = chunks
+        # Eager-finalize bookkeeping: the last chunk consumer to complete
+        # triggers finalize() from its executor thread, so host→device
+        # assembly of this array overlaps with other arrays' reads.
+        self._outstanding = 0
+        self._finalized = False
+        self._lock = threading.Lock()
+
+    def _on_req_done(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+            if self._outstanding != 0:
+                return
+        self.finalize()
+
+    def build_read_reqs(self) -> List[ReadReq]:
+        reqs: List[ReadReq] = []
+        itemsize = np.dtype(self._dtype).itemsize
+        for chunk_off, chunk_sz, location, chunk_checksum, compression in self._chunks:
+            copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Overlap]] = []
+            for region in self._regions:
+                ov = compute_overlap(chunk_off, chunk_sz, region.offsets, region.sizes)
+                if ov is not None:
+                    copies.append((region, ov.target_slices, ov))
+            if not copies:
+                continue
+            ranges = [
+                contiguous_byte_range(chunk_sz, ov.chunk_slices, itemsize)
+                for _, _, ov in copies
+            ]
+            chunk_nbytes = _chunk_nbytes(chunk_sz, itemsize)
+            partial = len(copies) > 1 or (
+                ranges[0] is not None and (ranges[0][1] - ranges[0][0]) < chunk_nbytes
+            )
+            # Compressed chunks admit no ranged reads (byte offsets into the
+            # compressed stream are meaningless): always read whole. Ranged
+            # reads also cannot verify the chunk's checksum (it covers the
+            # whole stored object) — TPUSNAPSHOT_STRICT_INTEGRITY=1 trades
+            # the ranged-read bandwidth savings for full verification.
+            strict = os.environ.get("TPUSNAPSHOT_STRICT_INTEGRITY") == "1"
+            if (
+                compression is None
+                and not strict
+                and all(r is not None for r in ranges)
+                and partial
+            ):
+                # Every overlap is a contiguous byte run of the chunk: issue
+                # one ranged read per target region (parallel, and each
+                # process/device fetches only the bytes it needs).
+                for (region, region_slices, ov), rng in zip(copies, ranges):
+                    full = tuple(slice(0, s) for s in ov.sizes)
+                    consumer = _ChunkCopyConsumer(
+                        view_shape=list(ov.sizes),
+                        dtype=self._dtype,
+                        copies=[(region, region_slices, full)],
+                        on_done=self._on_req_done,
+                    )
+                    reqs.append(
+                        ReadReq(
+                            path=location, buffer_consumer=consumer, byte_range=rng
+                        )
+                    )
+            else:
+                # Non-contiguous overlap somewhere: read the chunk once and
+                # scatter into every overlapping region. Whole-object reads
+                # can verify the stored checksum (ranged reads cannot).
+                consumer = _ChunkCopyConsumer(
+                    view_shape=list(chunk_sz),
+                    dtype=self._dtype,
+                    copies=[
+                        (region, region_slices, ov.chunk_slices)
+                        for region, region_slices, ov in copies
+                    ],
+                    checksum=chunk_checksum,
+                    compression=compression,
+                    on_done=self._on_req_done,
+                )
+                reqs.append(ReadReq(path=location, buffer_consumer=consumer))
+        with self._lock:
+            self._outstanding = len(reqs)
+        return reqs
+
+    def finalize(self) -> None:
+        # Idempotent: normally triggered eagerly by the last chunk consumer;
+        # the finalizer returned by prepare_read is the safety net for plans
+        # with zero read requests.
+        with self._lock:
+            if self._finalized:
+                return
+            self._finalized = True
+        if self._template_is_jax:
+            # One batched device_put for all shards: the runtime issues the
+            # host→device transfers in parallel (a serial per-shard loop is
+            # memcpy/PCIe-latency bound). Large buffers route through the
+            # chunked H2D path instead — a single big transfer leaves
+            # ~40% of the measured link bandwidth on the table
+            # (ops/transfer.py chunked_device_put).
+            buffers = []
+            devices = []
+            for region in self._regions:
+                for device in region.devices:
+                    buffers.append(region.buffer)
+                    devices.append(device)
+            if any(
+                should_chunk_h2d(buf, dev)
+                for buf, dev in zip(buffers, devices)
+            ):
+                arrays = [
+                    chunked_device_put(buf, dev)
+                    if should_chunk_h2d(buf, dev)
+                    else jax.device_put(buf, dev)
+                    for buf, dev in zip(buffers, devices)
+                ]
+            else:
+                arrays = jax.device_put(buffers, devices)
+            out = jax.make_array_from_single_device_arrays(
+                tuple(self._shape), self._sharding, arrays
+            )
+            if self._prng_impl is not None:
+                out = jax.random.wrap_key_data(out, impl=self._prng_impl)
+            self._callback(out)
+        else:
+            out = self._regions[0].buffer
+            if not out.flags.writeable:
+                # Adopted zero-copy payload views are read-only; host
+                # restores hand back writable arrays (apps mutate restored
+                # numpy state in place).
+                out = out.copy()
+            if self._prng_impl is not None:
+                out = jax.random.wrap_key_data(out, impl=self._prng_impl)
+            self._callback(out)
+
+
+def _chunk_nbytes(sizes: List[int], itemsize: int) -> int:
+    n = itemsize
+    for s in sizes:
+        n *= s
+    return n
+
+
+def _prepare_dense_array_write(
+    arr: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool,
+    compression: Optional[str] = None,
+    eager_host_copy: bool = True,
+) -> Tuple[ArrayEntry, List[WriteReq]]:
+    prng_impl = None
+    if _is_prng_key_array(arr):
+        prng_impl = str(jax.random.key_impl(arr))
+        arr = jax.random.key_data(arr)
+    dtype_name = dtype_to_str(arr.dtype)
+    location = get_storage_path(rank, logical_path, replicated)
+    entry = ArrayEntry(
+        location=location,
+        serializer=ARRAY_SERIALIZER,
+        dtype=dtype_name,
+        shape=list(arr.shape),
+        replicated=replicated,
+    )
+    if prng_impl is not None:
+        entry.prng_impl = prng_impl
+    stager = ArrayBufferStager(
+        arr, entry=entry, compression=compression, eager_host_copy=eager_host_copy
+    )
+    return entry, [WriteReq(path=location, buffer_stager=stager)]
+
+
+def _prepare_sharded_array_write(
+    arr: jax.Array,
+    logical_path: str,
+    compression: Optional[str] = None,
+    eager_host_copy: bool = True,
+) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
+    prng_impl = None
+    if _is_prng_key_array(arr):
+        # Persist sharded key arrays through their uint32 key data, which
+        # shares the keys' sharding (the trailing impl dim is unsharded).
+        prng_impl = str(jax.random.key_impl(arr))
+        arr = jax.random.key_data(arr)
+    dtype = np.dtype(arr.dtype)
+    dtype_name = dtype_to_str(dtype)
+    global_shape = list(arr.shape)
+    shards: List[Shard] = []
+    reqs: List[WriteReq] = []
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue  # exactly one process/device persists each region
+        off, sz = index_to_offsets_sizes(shard.index, global_shape)
+        pieces = subdivide(off, sz, dtype.itemsize, MAX_CHUNK_SIZE_BYTES)
+        whole = len(pieces) == 1
+        for c_off, c_sz in pieces:
+            location = chunk_location(logical_path, c_off)
+            entry = ArrayEntry(
+                location=location,
+                serializer=ARRAY_SERIALIZER,
+                dtype=dtype_name,
+                shape=list(c_sz),
+                replicated=False,
+            )
+            shards.append(Shard(offsets=list(c_off), sizes=list(c_sz), array=entry))
+            if whole:
+                stager = ArrayBufferStager(
+                    shard.data,
+                    entry=entry,
+                    compression=compression,
+                    eager_host_copy=eager_host_copy,
+                )
+            else:
+                local = tuple(
+                    slice(co - o, co - o + cs) for co, cs, o in zip(c_off, c_sz, off)
+                )
+                stager = ArrayBufferStager(
+                    shard.data,
+                    chunk_slices=local,
+                    nbytes=_chunk_nbytes(c_sz, dtype.itemsize),
+                    entry=entry,
+                    compression=compression,
+                )
+            reqs.append(WriteReq(path=location, buffer_stager=stager))
+    return (
+        ShardedArrayEntry(
+            dtype=dtype_name,
+            shape=global_shape,
+            shards=shards,
+            prng_impl=prng_impl,
+        ),
+        reqs,
+    )
+
+
+def prepare_write(
+    obj: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool = False,
+    compression: Optional[str] = None,
+    eager_host_copy: bool = True,
+) -> Tuple[Entry, List[WriteReq]]:
+    """Plan the persistence of one leaf value.
+
+    Reference analog: io_preparer.py:345-374. Returns the manifest entry
+    and the write requests this process is responsible for. For replicated
+    values the caller (Snapshot) drops the write reqs on non-owner ranks.
+    ``eager_host_copy=False`` (async takes) suppresses prepare-time
+    device→host copy kickoff — a device-staged cut would never consume it.
+    """
+    # numpy scalars subclass Python numbers (np.float64 is a float), so the
+    # array check must run before the primitive check.
+    if isinstance(obj, (np.generic, np.ndarray)):
+        return _prepare_dense_array_write(
+            np.asarray(obj), logical_path, rank, replicated, compression
+        )
+    if isinstance(obj, _PRIMITIVE_TYPES):
+        return PrimitiveEntry.from_value(obj, replicated=replicated), []
+    if _is_jax_array(obj) and _is_partitioned(obj):
+        return _prepare_sharded_array_write(
+            obj, logical_path, compression, eager_host_copy
+        )
+    if _is_jax_array(obj):
+        return _prepare_dense_array_write(
+            obj, logical_path, rank, replicated, compression, eager_host_copy
+        )
+    location = get_storage_path(rank, logical_path, replicated)
+    entry = ObjectEntry(
+        location=location, serializer=OBJECT_SERIALIZER, replicated=replicated
+    )
+    stager = ObjectBufferStager(obj, entry=entry, compression=compression)
+    return entry, [WriteReq(path=location, buffer_stager=stager)]
+
+
+def prepare_read(
+    entry: Entry,
+    template: Any,
+    callback: Callable[[Any], None],
+) -> Tuple[List[ReadReq], List[Callable[[], None]]]:
+    """Plan the restore of one leaf value into ``template``'s placement.
+
+    Reference analog: io_preparer.py:377-401. Returns read requests plus
+    finalizers to run after all reads complete (device assembly).
+    """
+    if isinstance(entry, PrimitiveEntry):
+        callback(entry.get_value())
+        return [], []
+    if isinstance(entry, ObjectEntry):
+        consumer = ObjectBufferConsumer(
+            callback, checksum=entry.checksum, compression=entry.compression
+        )
+        return [ReadReq(path=entry.location, buffer_consumer=consumer)], []
+    if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
+        plan = ArrayRestorePlan(entry, template, callback)
+        return plan.build_read_reqs(), [plan.finalize]
+    raise TypeError(f"Cannot prepare read for entry type {type(entry)}")

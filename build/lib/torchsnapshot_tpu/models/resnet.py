@@ -1,0 +1,201 @@
+"""Residual CNN: the data-parallel ("DDP ResNet") workload.
+
+BASELINE.json's second config is "DDP ResNet-18 replicated state_dict on
+8-chip v5e" — the reference's DDP benchmark path (reference
+benchmarks/ddp/main.py:38-70, tests/test_ddp.py) with a real conv model
+instead of synthetic parameters. This is a compact residual CNN whose
+checkpoint state exercises a category the transformer/DLRM families
+don't: non-trainable running statistics (batch norm), which must resume
+bit-exactly alongside params and momentum or eval metrics jump after
+restore.
+
+TPU-first design notes:
+- NHWC layout with ``lax.conv_general_dilated`` — XLA tiles NHWC convs
+  directly onto the MXU;
+- batch norm is functional: the train step takes and returns the
+  running-stats pytree (no mutable module state, jit-able);
+- DP rides the batch: inputs sharded ``P("dp", ...)`` over the mesh,
+  params replicated — XLA inserts the gradient all-reduce over ICI.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    in_channels: int = 3
+    widths: Tuple[int, ...] = (16, 32)  # one residual stage per width
+    blocks_per_stage: int = 2
+    num_classes: int = 10
+    image_size: int = 16
+    bn_momentum: float = 0.9
+    dtype: Any = jnp.float32
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (
+        jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+        * np.sqrt(2.0 / fan_in)
+    ).astype(dtype)
+
+
+def init_state(
+    config: ResNetConfig, key: jax.Array
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Returns (params, batch_stats) as plain-container pytrees."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    k_stem, k_head, *k_stages = jax.random.split(key, 2 + len(config.widths))
+
+    params["stem"] = _conv_init(
+        k_stem, 3, 3, config.in_channels, config.widths[0], config.dtype
+    )
+    cin = config.widths[0]
+    stages = []
+    stats_stages = []
+    for si, width in enumerate(config.widths):
+        blocks = []
+        stats_blocks = []
+        for bi in range(config.blocks_per_stage):
+            bk = jax.random.fold_in(k_stages[si], bi)
+            k1, k2, kp = jax.random.split(bk, 3)
+            block = {
+                "conv1": _conv_init(k1, 3, 3, cin, width, config.dtype),
+                "conv2": _conv_init(k2, 3, 3, width, width, config.dtype),
+                "bn1": {"scale": jnp.ones((width,), jnp.float32),
+                        "bias": jnp.zeros((width,), jnp.float32)},
+                "bn2": {"scale": jnp.ones((width,), jnp.float32),
+                        "bias": jnp.zeros((width,), jnp.float32)},
+            }
+            if cin != width:
+                block["proj"] = _conv_init(kp, 1, 1, cin, width, config.dtype)
+            blocks.append(block)
+            stats_blocks.append(
+                {
+                    "bn1": {"mean": jnp.zeros((width,), jnp.float32),
+                            "var": jnp.ones((width,), jnp.float32)},
+                    "bn2": {"mean": jnp.zeros((width,), jnp.float32),
+                            "var": jnp.ones((width,), jnp.float32)},
+                }
+            )
+            cin = width
+        stages.append(blocks)
+        stats_stages.append(stats_blocks)
+    params["stages"] = stages
+    stats["stages"] = stats_stages
+    params["head"] = {
+        "w": (
+            jax.random.normal(k_head, (cin, config.num_classes), jnp.float32)
+            / np.sqrt(cin)
+        ).astype(config.dtype),
+        "b": jnp.zeros((config.num_classes,), config.dtype),
+    }
+    return params, stats
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def _bn_train(x, bn, running, momentum):
+    """Batch norm in train mode; returns (y, new_running)."""
+    mean = jnp.mean(x, axis=(0, 1, 2))
+    var = jnp.var(x, axis=(0, 1, 2))
+    y = (x - mean) * jax.lax.rsqrt(var + 1e-5) * bn["scale"] + bn["bias"]
+    new_running = {
+        "mean": momentum * running["mean"] + (1 - momentum) * mean,
+        "var": momentum * running["var"] + (1 - momentum) * var,
+    }
+    return y, new_running
+
+
+def forward_train(
+    params: Dict[str, Any],
+    stats: Dict[str, Any],
+    images: jax.Array,  # [B, H, W, C]
+    config: ResNetConfig,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Logits [B, num_classes] and the updated running stats."""
+    x = _conv(images.astype(config.dtype), params["stem"])
+    new_stats = {"stages": []}
+    for blocks, stat_blocks in zip(params["stages"], stats["stages"]):
+        new_stat_blocks = []
+        for block, sb in zip(blocks, stat_blocks):
+            h, ns1 = _bn_train(_conv(x, block["conv1"]), block["bn1"],
+                               sb["bn1"], config.bn_momentum)
+            h = jax.nn.relu(h)
+            h, ns2 = _bn_train(_conv(h, block["conv2"]), block["bn2"],
+                               sb["bn2"], config.bn_momentum)
+            shortcut = _conv(x, block["proj"]) if "proj" in block else x
+            x = jax.nn.relu(h + shortcut)
+            new_stat_blocks.append({"bn1": ns1, "bn2": ns2})
+        new_stats["stages"].append(new_stat_blocks)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits.astype(jnp.float32), new_stats
+
+
+def sgd_train_step(
+    params: Dict[str, Any],
+    stats: Dict[str, Any],
+    images: jax.Array,
+    labels: jax.Array,  # [B] int32
+    config: ResNetConfig,
+    lr: float = 1e-2,
+) -> Tuple[Dict[str, Any], Dict[str, Any], jax.Array]:
+    """One SGD step; returns (params, stats, loss). Jit as one program."""
+
+    def loss_fn(p):
+        logits, new_stats = forward_train(p, stats, images, config)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+        return jnp.mean(nll), new_stats
+
+    (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    new_params = jax.tree.map(
+        lambda p, g: p - lr * g.astype(p.dtype), params, grads
+    )
+    return new_params, new_stats, loss
+
+
+def replicate_state(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree over the mesh (the DDP layout: every
+    device holds the whole model; gradients all-reduce over ICI)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def dp_shard_batch(
+    batch: jax.Array, mesh: Optional[Mesh]
+) -> jax.Array:
+    """Shard the leading (batch) dim over the mesh's "dp" axis."""
+    if mesh is None or "dp" not in mesh.axis_names:
+        return batch
+    spec = P("dp", *([None] * (batch.ndim - 1)))
+    return jax.device_put(batch, NamedSharding(mesh, spec))
+
+
+def synthetic_batch(
+    config: ResNetConfig, batch_size: int, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    ki, kl = jax.random.split(key)
+    images = jax.random.normal(
+        ki,
+        (batch_size, config.image_size, config.image_size, config.in_channels),
+        jnp.float32,
+    )
+    labels = jax.random.randint(
+        kl, (batch_size,), 0, config.num_classes, dtype=jnp.int32
+    )
+    return images, labels

@@ -1,0 +1,15 @@
+"""Device-side ops used by the snapshot pipelines."""
+
+from .transfer import (
+    device_clone,
+    is_oom_error,
+    parallel_device_get,
+    should_chunk_transfer,
+)
+
+__all__ = [
+    "device_clone",
+    "is_oom_error",
+    "parallel_device_get",
+    "should_chunk_transfer",
+]

@@ -1,0 +1,50 @@
+"""RNGState: capture/restore host-side RNG streams.
+
+TPU-native analog of reference torchsnapshot/rng_state.py:13-38, which wraps
+``torch.get_rng_state``/``set_rng_state``. In JAX, *device* randomness is
+explicit — PRNG key arrays are ordinary data and flow through the snapshot
+like any other array — so the remaining implicit state is host-side:
+
+- the global numpy RNG (``np.random.get_state``), commonly used by input
+  pipelines and data augmentation, and
+- Python's ``random`` module state.
+
+``Snapshot.take`` guarantees the RNG state captured in the snapshot is the
+state a restored program observes: the RNG stateful is saved *first* and its
+state re-loaded *after* all other statefuls have been saved, so RNG
+side effects of other statefuls' ``state_dict()`` calls do not leak into the
+post-take program (reference: torchsnapshot/snapshot.py:174-191, 216-221).
+At most one ``RNGState`` may appear in an app state.
+"""
+
+import random
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RNGState:
+    """A ``Stateful`` that captures host-side RNG streams."""
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "numpy_rng_state": np.random.get_state(),
+            "python_rng_state": random.getstate(),
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        np_state = state_dict["numpy_rng_state"]
+        # The state tuple's second element may round-trip as a list/array of
+        # ints; np.random.set_state requires the canonical tuple form.
+        if isinstance(np_state, (list, tuple)):
+            np_state = tuple(
+                np.asarray(e, dtype=np.uint32) if isinstance(e, (list, np.ndarray)) and i == 1 else e
+                for i, e in enumerate(np_state)
+            )
+        np.random.set_state(np_state)
+        py_state = state_dict["python_rng_state"]
+        if isinstance(py_state, list):
+            py_state = tuple(
+                tuple(e) if isinstance(e, list) else e for e in py_state
+            )
+        random.setstate(py_state)

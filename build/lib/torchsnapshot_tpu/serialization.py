@@ -1,0 +1,137 @@
+"""Array and object (de)serialization.
+
+The reference serializes every leaf with ``torch.save`` (pickle framing,
+~2x peak memory, reference io_preparer.py:216-223).  The TPU build instead
+persists arrays as **raw little-endian C-order payload bytes** — dtype and
+shape live in the manifest entry, so deserialization is a zero-copy
+``np.frombuffer(...).reshape(...)``.  This halves staging cost, makes every
+stored object directly mmap-able, and guarantees bit-exact round-trips for
+every JAX dtype including ``bfloat16``, ``float8_*`` (via ml_dtypes) and
+PRNG key arrays (persisted through their uint32 key data).
+
+Objects (non-array leaves) use pickle protocol 4.
+"""
+
+import pickle
+import sys
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+try:
+    import ml_dtypes  # registers bfloat16/float8 etc. with numpy
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+
+ARRAY_SERIALIZER = "raw"
+OBJECT_SERIALIZER = "pickle"
+
+_BIG_ENDIAN = sys.byteorder == "big"
+
+
+def dtype_to_str(dtype: Any) -> str:
+    """Canonical dtype name, stable across numpy/ml_dtypes/jax."""
+    return np.dtype(dtype).name
+
+
+def str_to_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    if ml_dtypes is not None:
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            pass
+    raise TypeError(f"Unknown dtype name: {name}")
+
+
+def array_to_bytes(arr: np.ndarray) -> bytes:
+    """Serialize to little-endian C-order payload bytes."""
+    arr = np.ascontiguousarray(arr)
+    if _BIG_ENDIAN and arr.dtype.byteorder == ">":  # pragma: no cover
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return arr.tobytes()
+
+
+def bytes_to_array(buf: bytes, dtype_name: str, shape: List[int]) -> np.ndarray:
+    """Zero-copy deserialize payload bytes into an ndarray view."""
+    dtype = str_to_dtype(dtype_name)
+    arr = np.frombuffer(buf, dtype=dtype)
+    return arr.reshape(shape)
+
+
+def array_nbytes(dtype_name: str, shape: List[int]) -> int:
+    n = str_to_dtype(dtype_name).itemsize
+    for dim in shape:
+        n *= dim
+    return n
+
+
+def object_to_bytes(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=4)
+
+
+def bytes_to_object(buf: bytes) -> Any:
+    return pickle.loads(buf)
+
+
+def array_meta(arr: np.ndarray) -> Tuple[str, List[int]]:
+    return dtype_to_str(arr.dtype), list(arr.shape)
+
+
+_COMPRESSION_LEVELS = {"zlib": 1}  # level 1: ~5-10x faster than default,
+# within a few % of its ratio on float payloads (which barely compress
+# past byte-level redundancy anyway).
+
+
+def check_compression(algo: Optional[str]) -> None:
+    if algo is not None and algo not in _COMPRESSION_LEVELS:
+        raise ValueError(
+            f'Unknown compression algorithm "{algo}". '
+            f"Supported: {sorted(_COMPRESSION_LEVELS)}."
+        )
+
+
+def compress_payload(buf: Any, algo: str) -> bytes:
+    """Losslessly compress a payload (beyond reference parity).
+
+    Trades host CPU for storage bytes/bandwidth; bit-exactness is
+    unaffected (the decompressed payload is byte-identical). Worthwhile
+    when storage is the bottleneck and the state is compressible (e.g.
+    embedding tables with cold rows, int tokenizer state); opt-in because
+    well-trained float weights are near-incompressible.
+    """
+    check_compression(algo)
+    return zlib.compress(buf, level=_COMPRESSION_LEVELS[algo])
+
+
+def decompress_payload(buf: Any, algo: str) -> bytes:
+    check_compression(algo)
+    return zlib.decompress(buf)
+
+
+def compute_checksum(buf: Any) -> str:
+    """crc32 of a payload, tagged with the algorithm for evolvability.
+
+    Beyond reference parity: torchsnapshot has no integrity checking
+    (SURVEY §5 — silent storage corruption flows straight into restored
+    weights). zlib.crc32 runs >1 GB/s in C with the GIL released, so it is
+    ~free inside the staging thread pool.
+    """
+    return f"crc32:{zlib.crc32(buf) & 0xFFFFFFFF:08x}"
+
+
+def verify_checksum(buf: Any, expected: Optional[str]) -> None:
+    """Raise if ``buf`` does not match ``expected`` (no-op when expected is
+    None or the algorithm is unknown — forward compatibility)."""
+    if not expected or not expected.startswith("crc32:"):
+        return
+    actual = compute_checksum(buf)
+    if actual != expected:
+        raise RuntimeError(
+            f"Checksum mismatch: stored object is corrupt "
+            f"(expected {expected}, got {actual})."
+        )

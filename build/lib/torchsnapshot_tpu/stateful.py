@@ -1,0 +1,38 @@
+"""Stateful protocol: the unit of checkpointable application state.
+
+TPU-native analog of the reference protocol (reference:
+torchsnapshot/stateful.py:13-22). Anything that can produce and absorb a
+state dict — a train-state wrapper, a data-loader cursor, a metric
+accumulator — participates in snapshotting by implementing this protocol.
+
+In the JAX build a "state dict" is a *pytree of plain containers*
+(dict / OrderedDict / list / tuple) whose leaves are ``jax.Array``,
+``numpy.ndarray``, or arbitrary picklable objects. Helpers for converting
+flax/optax train states into plain containers live in
+``torchsnapshot_tpu.utils.tree``.
+"""
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    """Protocol for checkpointable objects.
+
+    ``state_dict`` returns a pytree of plain containers; ``load_state_dict``
+    absorbs one.  ``state_dict`` may run collectives (e.g. gather sharded
+    state) — ``Snapshot`` guarantees all processes call the statefuls in the
+    same global order with barriers in between so interleaved collectives
+    from different statefuls cannot deadlock.
+    """
+
+    def state_dict(self) -> Dict[str, Any]:
+        ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        ...
+
+
+# The top-level unit handed to Snapshot.take / restore: a mapping from a
+# user-chosen key (e.g. "model", "optim", "progress") to a Stateful.
+AppState = Dict[str, Stateful]

@@ -1,0 +1,64 @@
+"""URL → StoragePlugin dispatch.
+
+TPU-native analog of reference torchsnapshot/storage_plugin.py:16-60.
+Protocols: ``fs`` (default when no ``://`` present), ``memory``, ``gs``,
+``s3``; unknown protocols resolve through the ``storage_plugins`` Python
+entry-point group so third-party backends can register themselves
+(reference storage_plugin.py:43-58).
+"""
+
+from importlib import metadata as importlib_metadata
+from typing import Dict, Optional
+
+from .io_types import RetryingStoragePlugin, StoragePlugin
+from .storage_plugins.fs import FSStoragePlugin
+from .storage_plugins.memory import MemoryStoragePlugin
+
+# Shared in-memory "buckets" keyed by root so that memory://foo resolves to
+# the same store across plugin instances within a process (tests, async
+# staging targets).
+_MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
+
+
+def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    """Resolve a URL to its backend, wrapped with the retry policy (every
+    storage op — payloads, metadata commit, markers, deletes — retries
+    transient failures; see io_types.retry_storage_op)."""
+    return RetryingStoragePlugin(_resolve_plugin(url_path))
+
+
+def _resolve_plugin(url_path: str) -> StoragePlugin:
+    if "://" in url_path:
+        protocol, path = url_path.split("://", 1)
+        if protocol == "":
+            protocol = "fs"
+    else:
+        protocol, path = "fs", url_path
+
+    if protocol == "fs":
+        return FSStoragePlugin(root=path)
+    if protocol == "memory":
+        store = _MEMORY_STORES.setdefault(path, {})
+        return MemoryStoragePlugin(store=store)
+    if protocol == "gs":
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path)
+    if protocol == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path)
+
+    # Third-party plugins via entry points.
+    try:
+        eps = importlib_metadata.entry_points()
+        if hasattr(eps, "select"):
+            group = eps.select(group="storage_plugins")
+        else:  # pragma: no cover
+            group = eps.get("storage_plugins", [])
+        for ep in group:
+            if ep.name == protocol:
+                return ep.load()(path)
+    except Exception:
+        pass
+    raise RuntimeError(f"Unsupported protocol: {protocol}")

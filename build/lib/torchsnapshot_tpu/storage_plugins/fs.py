@@ -1,0 +1,93 @@
+"""Local-filesystem storage plugin.
+
+TPU-native analog of reference torchsnapshot/storage_plugins/fs.py:19-45.
+Uses ``asyncio.to_thread``-style executor offloading (via
+``loop.run_in_executor``) instead of aiofiles so large writes release the
+GIL in one ``file.write`` call; parent-directory creation is cached
+(reference fs.py:22,27-30). Supports ranged reads for partial chunk
+fetches during resharding.
+"""
+
+import asyncio
+import os
+from typing import Optional, Set, Tuple
+
+from ..io_types import IOReq, StoragePlugin
+
+
+class FSStoragePlugin(StoragePlugin):
+    # Local disks lose throughput to writeback contention under parallel
+    # write streams (measured ~2.5x slower at 4+ writers on cloud-VM
+    # disks); two keeps the device busy across file boundaries without
+    # thrashing. Reads keep the default fan-out (queue depth helps).
+    max_write_concurrency = 2
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._dir_cache: Set[str] = set()
+
+    def _prepare_dir(self, path: str) -> None:
+        dir_path = os.path.dirname(os.path.join(self.root, path))
+        if dir_path and dir_path not in self._dir_cache:
+            os.makedirs(dir_path, exist_ok=True)
+            self._dir_cache.add(dir_path)
+
+    def _write_sync(self, io_req: IOReq) -> None:
+        self._prepare_dir(io_req.path)
+        full = os.path.join(self.root, io_req.path)
+        # Write to a temp name then rename for per-object atomicity (the
+        # reference has no partial-write protection; POSIX rename is free).
+        tmp = f"{full}.tmp{os.getpid()}"
+        payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, full)
+
+    def _read_sync(self, io_req: IOReq) -> None:
+        full = os.path.join(self.root, io_req.path)
+        with open(full, "rb") as f:
+            if io_req.byte_range is not None:
+                start, end = io_req.byte_range
+                f.seek(start)
+                payload = f.read(end - start)
+            else:
+                payload = f.read()
+        # Return via `data`: zero-copy for consumers. Callers that want the
+        # BytesIO interface read io_req.data themselves (wrapping here
+        # would memcpy every payload).
+        io_req.data = payload
+
+    async def write(self, io_req: IOReq) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._write_sync, io_req)
+
+    async def read(self, io_req: IOReq) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._read_sync, io_req)
+
+    async def delete(self, path: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, os.remove, os.path.join(self.root, path))
+
+    def _list_sync(self, prefix: str):
+        base = os.path.join(self.root, prefix) if prefix else self.root
+        found = []
+        # A prefix may name a directory or a filename prefix; object-store
+        # semantics are pure string prefixes, so cover both.
+        for root_dir in {os.path.dirname(base) or self.root, base}:
+            if not os.path.isdir(root_dir):
+                continue
+            for dirpath, _, filenames in os.walk(root_dir):
+                for name in filenames:
+                    full = os.path.join(dirpath, name)
+                    rel = os.path.relpath(full, self.root)
+                    if rel.startswith(prefix) and rel not in found:
+                        found.append(rel)
+        return found
+
+    async def list_prefix(self, prefix: str):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._list_sync, prefix)
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,168 @@
+"""Google Cloud Storage plugin — the TPU-VM fast path.
+
+TPU-native analog of reference torchsnapshot/storage_plugins/gcs.py:19-68.
+TPU VMs sit next to GCS, so ``gs://`` is the north-star storage target
+(BASELINE.json). The sync ``google-cloud-storage`` client is wrapped in a
+thread executor (reference gcs.py:41,48-50); ranged reads map to
+``blob.download_as_bytes(start=, end=)`` so resharding restores fetch only
+overlapping byte ranges.
+"""
+
+import asyncio
+import os
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from ..io_types import IOReq, StoragePlugin
+
+_IO_THREADS = 8
+
+# Objects at least this large upload as concurrent parts + one server-side
+# compose (GCS caps compose at 32 components). A single synchronous
+# upload_from_file stream tops out well below NIC bandwidth for the 512 MB
+# chunks the io preparer emits; parallel part uploads are the standard GCS
+# recipe for large objects (gsutil -o GSUtil:parallel_composite_upload).
+_PARALLEL_UPLOAD_ENV = "TPUSNAPSHOT_GCS_PARALLEL_UPLOAD_BYTES"
+_DEFAULT_PARALLEL_UPLOAD_BYTES = 64 * 1024 * 1024
+_MAX_COMPOSE_COMPONENTS = 32
+
+
+def _parallel_upload_threshold() -> int:
+    return int(
+        os.environ.get(_PARALLEL_UPLOAD_ENV, _DEFAULT_PARALLEL_UPLOAD_BYTES)
+    )
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str, client: Optional[Any] = None) -> None:
+        """``client`` injects a pre-built (or fake) ``storage.Client`` —
+        the default constructs one from ambient credentials."""
+        components = root.split("/", 1)
+        if len(components) != 2:
+            raise ValueError(
+                f'GCS root must be a "bucket/path" pair, got "{root}".'
+            )
+        self.bucket_name, self.root = components
+        if client is None:
+            try:
+                from google.cloud import storage  # type: ignore
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "GCS support requires the google-cloud-storage package."
+                ) from e
+            client = storage.Client()
+        self._client = client
+        self._bucket = self._client.bucket(self.bucket_name)
+        self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
+
+    def _blob(self, path: str):
+        return self._bucket.blob(f"{self.root}/{path}")
+
+    def _write_sync(self, io_req: IOReq) -> None:
+        if io_req.data is not None:
+            import io as _io
+
+            self._blob(io_req.path).upload_from_file(_io.BytesIO(io_req.data))
+        else:
+            io_req.buf.seek(0)
+            self._blob(io_req.path).upload_from_file(io_req.buf)
+
+    def _upload_part_sync(self, key: str, payload) -> None:
+        import io as _io
+
+        self._bucket.blob(key).upload_from_file(_io.BytesIO(payload))
+
+    async def _parallel_composite_upload(self, path: str, payload) -> None:
+        """Upload ``payload`` as ≤32 concurrent parts + one compose.
+
+        Part objects are nonce-named (concurrent takes to the same path
+        must not collide) and best-effort deleted afterwards — a crashed
+        upload's parts are swept by ``Snapshot.delete(sweep=True)``.
+        """
+        view = memoryview(payload)
+        n_parts = min(
+            _MAX_COMPOSE_COMPONENTS,
+            max(1, -(-len(view) // _parallel_upload_threshold())),
+        )
+        bounds = [
+            len(view) * i // n_parts for i in range(n_parts + 1)
+        ]
+        nonce = uuid.uuid4().hex[:12]
+        part_keys = [
+            f"{self.root}/{path}.part{i}.{nonce}" for i in range(n_parts)
+        ]
+        loop = asyncio.get_running_loop()
+        try:
+            await asyncio.gather(
+                *(
+                    loop.run_in_executor(
+                        self._executor,
+                        self._upload_part_sync,
+                        part_keys[i],
+                        view[bounds[i] : bounds[i + 1]],
+                    )
+                    for i in range(n_parts)
+                )
+            )
+            await loop.run_in_executor(
+                self._executor,
+                lambda: self._blob(path).compose(
+                    [self._bucket.blob(k) for k in part_keys]
+                ),
+            )
+        finally:
+            for k in part_keys:
+
+                def _best_effort_delete(k=k):
+                    try:
+                        self._bucket.blob(k).delete()
+                    except Exception:
+                        pass
+
+                await loop.run_in_executor(
+                    self._executor, _best_effort_delete
+                )
+
+    def _read_sync(self, io_req: IOReq) -> None:
+        blob = self._blob(io_req.path)
+        if io_req.byte_range is not None:
+            start, end = io_req.byte_range
+            data = blob.download_as_bytes(start=start, end=end - 1)
+        else:
+            data = blob.download_as_bytes()
+        io_req.data = data
+
+    async def write(self, io_req: IOReq) -> None:
+        payload = (
+            io_req.data
+            if io_req.data is not None
+            else io_req.buf.getbuffer()
+        )
+        if len(payload) >= _parallel_upload_threshold():
+            # Orchestrated from the event loop (no executor thread blocks
+            # waiting on part futures — the 8 IO threads all push bytes).
+            await self._parallel_composite_upload(io_req.path, payload)
+            return
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._executor, self._write_sync, io_req)
+
+    async def read(self, io_req: IOReq) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._executor, self._read_sync, io_req)
+
+    async def delete(self, path: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._executor, self._blob(path).delete)
+
+    def _list_sync(self, prefix: str):
+        full_prefix = f"{self.root}/{prefix}" if prefix else f"{self.root}/"
+        blobs = self._client.list_blobs(self.bucket_name, prefix=full_prefix)
+        return [b.name[len(self.root) + 1 :] for b in blobs]
+
+    async def list_prefix(self, prefix: str):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, self._list_sync, prefix)
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=True)

@@ -1,0 +1,2 @@
+from .train_state import FnStateful, PytreeStateful  # noqa: F401
+from .tree import from_state_dict, to_state_dict  # noqa: F401

@@ -1,0 +1,111 @@
+"""Test utilities.
+
+TPU-native analog of reference torchsnapshot/test_utils.py:21-106. The
+reference monkey-patches ``Tensor.__eq__`` so ``assertDictEqual`` recurses;
+pytrees compare structurally, so the equality helpers here are plain
+recursive functions over containers with ``np.array_equal`` (bit-exact by
+default — the contract is exact resume) or ``np.allclose`` on arrays.
+
+``run_multiprocess`` replaces the reference's torchelastic launch pattern
+(test_utils.py:87-106): it forks N python processes that coordinate
+through a ``FileStore``, giving real multi-process collectives without a
+cluster.
+"""
+
+import multiprocessing as mp
+import traceback
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+def _leaf_eq(a: Any, b: Any, exact: bool) -> bool:
+    a_arr = _as_array(a)
+    b_arr = _as_array(b)
+    if a_arr is not None and b_arr is not None:
+        if a_arr.dtype != b_arr.dtype or a_arr.shape != b_arr.shape:
+            return False
+        if exact:
+            return bool(np.array_equal(a_arr, b_arr))
+        return bool(
+            np.allclose(
+                a_arr.astype(np.float64)
+                if a_arr.dtype.kind in "fc" and a_arr.dtype.itemsize < 4
+                else a_arr,
+                b_arr.astype(np.float64)
+                if b_arr.dtype.kind in "fc" and b_arr.dtype.itemsize < 4
+                else b_arr,
+            )
+        )
+    if (a_arr is None) != (b_arr is None):
+        return False
+    return bool(a == b)
+
+
+def _as_array(x: Any) -> Optional[np.ndarray]:
+    import jax
+
+    if isinstance(x, np.ndarray):
+        return x
+    if isinstance(x, jax.Array):
+        if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(x))
+        return np.asarray(x)
+    return None
+
+
+def check_state_dict_eq(a: Any, b: Any, exact: bool = True) -> bool:
+    """Structural equality of two state dicts, array-aware."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        if set(map(str, a.keys())) != set(map(str, b.keys())):
+            return False
+        b_by_str = {str(k): v for k, v in b.items()}
+        return all(
+            check_state_dict_eq(v, b_by_str[str(k)], exact) for k, v in a.items()
+        )
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return False
+        return all(check_state_dict_eq(x, y, exact) for x, y in zip(a, b))
+    return _leaf_eq(a, b, exact)
+
+
+def assert_state_dict_eq(a: Any, b: Any, exact: bool = True) -> None:
+    assert check_state_dict_eq(a, b, exact), (
+        f"State dicts differ:\n--- a ---\n{a}\n--- b ---\n{b}"
+    )
+
+
+def _mp_worker(fn, rank, nprocs, store_path, args, err_queue) -> None:
+    try:
+        fn(rank, nprocs, store_path, *args)
+    except BaseException:
+        err_queue.put((rank, traceback.format_exc()))
+        raise
+
+
+def run_multiprocess(
+    fn: Callable, nprocs: int, store_path: str, args: tuple = ()
+) -> None:
+    """Fork ``nprocs`` processes running ``fn(rank, nprocs, store_path,
+    *args)``; raise if any fails. Workers build their own
+    ``StoreCoordinator(FileStore(store_path), rank, nprocs)``."""
+    ctx = mp.get_context("spawn")
+    err_queue = ctx.Queue()
+    procs: List[mp.Process] = []
+    for rank in range(nprocs):
+        p = ctx.Process(
+            target=_mp_worker, args=(fn, rank, nprocs, store_path, args, err_queue)
+        )
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join(timeout=600)
+    failures = []
+    while not err_queue.empty():
+        failures.append(err_queue.get())
+    for p in procs:
+        if p.exitcode != 0:
+            failures.append((p.pid, f"exitcode={p.exitcode}"))
+    if failures:
+        raise RuntimeError(f"Worker failures: {failures}")

@@ -1,0 +1,53 @@
+"""Pytree ⇄ plain-container conversion helpers.
+
+Statefuls feed :mod:`torchsnapshot_tpu.flatten` with plain containers
+(dict / OrderedDict / list / tuple). Arbitrary pytrees — flax structs,
+optax NamedTuple states, custom nodes — convert losslessly through these
+helpers: ``to_state_dict`` turns any pytree into plain containers while
+recording enough structure to invert with ``from_state_dict``.
+"""
+
+from typing import Any, Dict
+
+import jax
+
+
+def to_state_dict(tree: Any) -> Dict[str, Any]:
+    """Convert an arbitrary pytree into nested plain dicts keyed by the
+    jax ``KeyPath`` component names. NamedTuples become dicts of their
+    fields, custom nodes dicts of their child keys."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: Dict[str, Any] = {}
+    for path, leaf in leaves_with_paths:
+        node = out
+        keys = [_key_str(k) for k in path] or ["value"]
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return out
+
+
+def from_state_dict(tree_template: Any, state_dict: Dict[str, Any]) -> Any:
+    """Inverse of :func:`to_state_dict`: pour the state dict's leaves back
+    into the structure of ``tree_template``."""
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree_template)
+    new_leaves = []
+    for path, _ in paths_and_leaves:
+        node = state_dict
+        keys = [_key_str(k) for k in path] or ["value"]
+        for k in keys:
+            node = node[k]
+        new_leaves.append(node)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _key_str(key: Any) -> str:
+    if isinstance(key, jax.tree_util.DictKey):
+        return str(key.key)
+    if isinstance(key, jax.tree_util.SequenceKey):
+        return str(key.idx)
+    if isinstance(key, jax.tree_util.GetAttrKey):
+        return str(key.name)
+    if isinstance(key, jax.tree_util.FlattenedIndexKey):
+        return str(key.key)
+    return str(key)

@@ -121,6 +121,62 @@ def test_not_found_is_never_retried():
     assert len(calls) == 1
 
 
+def test_transient_error_with_404_in_message_is_retried():
+    """Classification is structural, never by message substring: a proxied
+    HTML error body (or request id) containing "404"/"Not Found" is a
+    transient failure and MUST be retried — treating it as a missing
+    object would abort reads and stall async-commit polling."""
+    from torchsnapshot_tpu.io_types import is_not_found_error
+
+    proxy_err = ConnectionError(
+        "<html>504 gateway timeout; upstream said: Not Found (404); "
+        "request-id: ab404cd</html>"
+    )
+    assert not is_not_found_error(proxy_err)
+
+    calls = []
+
+    async def _flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ConnectionError("proxy error: 404 Not Found in body")
+        return "ok"
+
+    assert asyncio.run(retry_storage_op(_flaky, "read(w)")) == "ok"
+    assert len(calls) == 2
+
+
+def test_structured_not_found_codes_classified():
+    """botocore-style response dicts and google-style .code attributes
+    classify as not-found without any name/message matching."""
+    from torchsnapshot_tpu.io_types import is_not_found_error
+
+    class ClientError(Exception):
+        def __init__(self, response):
+            super().__init__("An error occurred")
+            self.response = response
+
+    assert is_not_found_error(
+        ClientError({"Error": {"Code": "NoSuchKey"}})
+    )
+    assert is_not_found_error(
+        ClientError({"ResponseMetadata": {"HTTPStatusCode": 404}})
+    )
+    assert not is_not_found_error(
+        ClientError({"ResponseMetadata": {"HTTPStatusCode": 500}})
+    )
+
+    class ApiError(Exception):
+        code = 404
+
+    assert is_not_found_error(ApiError("gone"))
+
+    class ApiError500(Exception):
+        code = 500
+
+    assert not is_not_found_error(ApiError500("boom"))
+
+
 def test_tracing_records_snapshot_spans(tmp_path):
     trace_path = str(tmp_path / "trace.json")
     state = StateDict(w=jnp.arange(16, dtype=jnp.float32))
@@ -189,6 +245,75 @@ def test_delete_is_idempotent_and_cleans_async_markers(tmp_path):
     assert leftovers == []
     with pytest.raises(FileNotFoundError):
         Snapshot(path).delete()  # metadata already gone
+
+
+def test_delete_sweep_removes_orphans(tmp_path):
+    """delete(sweep=True) enumerates the prefix and removes objects the
+    manifest does not reference — leftovers of interrupted/superseded
+    takes at the same path (ADVICE r1: plain delete leaked them)."""
+    path = str(tmp_path / "snap")
+    state = StateDict(a=jnp.arange(8, dtype=jnp.float32))
+    Snapshot.take(path, {"s": state})
+    # Orphans a crashed earlier take could leave: an uncommitted payload
+    # chunk and completion markers under a different nonce.
+    os.makedirs(os.path.join(path, ".completed", "deadbeef"), exist_ok=True)
+    with open(os.path.join(path, ".completed", "deadbeef", "0"), "w") as f:
+        f.write("stale")
+    os.makedirs(os.path.join(path, "7"), exist_ok=True)
+    with open(os.path.join(path, "7", "orphan_chunk"), "wb") as f:
+        f.write(b"\x00" * 64)
+
+    # Plain delete leaves the orphans (documented behavior)...
+    Snapshot(path).delete()
+    leftovers = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert len(leftovers) == 2
+
+    # ...sweep removes them, even with the metadata already gone.
+    Snapshot(path).delete(sweep=True)
+    leftovers = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert leftovers == []
+
+
+def test_delete_sweep_never_escapes_snapshot_root(tmp_path):
+    """list_prefix("") must enumerate only the plugin root: sweeping
+    snap-1 must not see (or delete) a sibling snap-2 in the same parent
+    directory (code-review r2 finding: walking dirname(root) for an
+    empty prefix exposed siblings to the sweep)."""
+    s1, s2 = str(tmp_path / "snap-1"), str(tmp_path / "snap-2")
+    state = StateDict(a=jnp.arange(4, dtype=jnp.float32))
+    Snapshot.take(s1, {"s": state})
+    Snapshot.take(s2, {"s": state})
+
+    Snapshot(s1).delete(sweep=True)
+
+    # snap-2 untouched and fully restorable.
+    target = StateDict(a=jnp.zeros(4, dtype=jnp.float32))
+    Snapshot(s2).restore({"s": target})
+    assert np.allclose(np.asarray(target["a"]), np.arange(4))
+    # snap-1 empty.
+    leftovers = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(s1)
+        for f in fs
+    ]
+    assert leftovers == []
+
+
+def test_delete_sweep_memory_backend():
+    from torchsnapshot_tpu.storage_plugin import _MEMORY_STORES
+
+    path = "memory://sweeptest"
+    state = StateDict(a=jnp.arange(4, dtype=jnp.float32))
+    Snapshot.take(path, {"s": state})
+    store = _MEMORY_STORES["sweeptest"]
+    store["0/orphan"] = b"x"
+    store[".completed/oldnonce/0"] = b"y"
+    Snapshot(path).delete(sweep=True)
+    assert store == {}
 
 
 def test_inspect_cli_delete(tmp_path, capsys):

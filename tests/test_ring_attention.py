@@ -278,3 +278,233 @@ def test_ring_flash_chunk_too_small_rejected():
     q, k, v = _qkv((1, 1, 8, 8))  # chunk = 1 per device
     with pytest.raises(ValueError, match="power-of-two factor"):
         ra(q, k, v, mesh, chunk_impl="flash")
+
+
+def test_flash_chunk_attention_vjp_matches_einsum():
+    """flash_chunk_attention returns (out, lse) and differentiates w.r.t.
+    BOTH cotangents: the lse cotangent folds into the tiled backward as
+    delta' = delta - dlse. Reference: einsum attention + logsumexp."""
+    from torchsnapshot_tpu.ops.attention import flash_chunk_attention
+
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    shape = (2, 2, 64, 16)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+
+    def ref_pair(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (
+            d**0.5
+        )
+        length = q.shape[2]
+        mask = jnp.tril(jnp.ones((length, length), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        lse = jax.nn.logsumexp(s, axis=-1, keepdims=True)
+        out = jnp.einsum(
+            "bhqk,bhkd->bhqd", jnp.exp(s - lse), v.astype(jnp.float32)
+        )
+        return out, lse
+
+    # A loss touching both outputs, so both cotangents are nonzero.
+    def loss_flash(q, k, v):
+        out, lse = flash_chunk_attention(q, k, v, True, 32, 32, True)
+        return jnp.sum(out.astype(jnp.float32) ** 2) + jnp.sum(
+            jnp.sin(lse)
+        )
+
+    def loss_ref(q, k, v):
+        out, lse = ref_pair(q, k, v)
+        return jnp.sum(out**2) + jnp.sum(jnp.sin(lse))
+
+    np.testing.assert_allclose(
+        float(loss_flash(q, k, v)), float(loss_ref(q, k, v)), rtol=1e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4
+        )
+
+
+def test_ring_flash_gradients_match_einsum_ring():
+    """VERDICT #5 done-criterion: gradient parity of
+    ring_attention(chunk_impl="flash") vs the einsum ring on a dp x sp
+    mesh — long-context training keeps the fused kernel's memory bound."""
+    devices = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "sp"))
+    q, k, v = _qkv((2, 2, 128, 16), seed=29)
+    spec = P("dp", None, "sp", None)
+    qs, ks, vs = (
+        jax.device_put(t, NamedSharding(mesh, spec)) for t in (q, k, v)
+    )
+
+    def loss(impl):
+        def f(q, k, v):
+            out = ring_attention(
+                q, k, v, mesh, causal=True, spec=spec, chunk_impl=impl
+            )
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        return f
+
+    gf = jax.grad(loss("flash"), argnums=(0, 1, 2))(qs, ks, vs)
+    ge = jax.grad(loss("einsum"), argnums=(0, 1, 2))(qs, ks, vs)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4
+        )
+
+
+def test_zigzag_flash_chunks_match_dense_and_differentiate():
+    from torchsnapshot_tpu.parallel.ring_attention import (
+        from_zigzag,
+        ring_attention_zigzag,
+        to_zigzag,
+        zigzag_indices,
+    )
+
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q, k, v = _qkv((1, 2, 256, 16), seed=31)
+    qz, kz, vz = (to_zigzag(t, mesh) for t in (q, k, v))
+    out = from_zigzag(
+        ring_attention_zigzag(qz, kz, vz, mesh, chunk_impl="flash"), mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_reference_attention(q, k, v, True)),
+        atol=3e-5,
+        rtol=1e-5,
+    )
+
+    idx = zigzag_indices(256, 8)
+    spec = P(None, None, "sp", None)
+
+    def loss(impl):
+        def f(q, k, v):
+            qz, kz, vz = (jnp.take(t, idx, axis=2) for t in (q, k, v))
+            out = ring_attention_zigzag(
+                qz, kz, vz, mesh, spec=spec, chunk_impl=impl
+            )
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        return f
+
+    gf = jax.grad(loss("flash"), argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss("einsum"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4
+        )
+
+
+def test_zigzag_layout_balances_causal_work():
+    """The layout property that makes zigzag worth integrating: per-device
+    causal sub-chunk attention count is CONSTANT, while the contiguous
+    layout's grows linearly with ring position. Counted from the same
+    (q_id, k_id) visibility rule the kernels' lax.cond predicates encode."""
+    n = 8
+    # zigzag: device j owns q sub-chunks {j, 2n-1-j}; over the n ring
+    # steps it sees every k sub-chunk pair {src, 2n-1-src}.
+    zig_work = []
+    for j in range(n):
+        q_ids = (j, 2 * n - 1 - j)
+        count = sum(
+            1
+            for src in range(n)
+            for k_id in (src, 2 * n - 1 - src)
+            for q_id in q_ids
+            if k_id <= q_id
+        )
+        zig_work.append(count)
+    assert len(set(zig_work)) == 1, zig_work  # constant across devices
+
+    # contiguous: device j owns q chunk j and attends k chunks 0..j.
+    contig_work = [j + 1 for j in range(n)]
+    assert max(contig_work) == n * min(contig_work)  # n-fold imbalance
+
+
+def test_transformer_zigzag_train_step_matches_dense():
+    """VERDICT #4 done-criterion: TransformerConfig(ring_attention=
+    "zigzag") trains on a dp x sp x tp mesh; loss and one SGD step match
+    the dense einsum config to float tolerance (the loss permutes
+    tokens/targets to zigzag order; CE is permutation-invariant)."""
+    from torchsnapshot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+        sgd_train_step,
+        shard_params,
+    )
+
+    devices = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devices, ("dp", "sp", "tp"))
+    kw = dict(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32,
+    )
+    base = TransformerConfig(**kw)
+    zig = TransformerConfig(**kw, ring_attention="zigzag")
+    params = shard_params(init_params(base, jax.random.key(0)), mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, 64),
+        NamedSharding(mesh, P("dp", "sp")),
+    )
+    loss_base = jax.jit(lambda p, t: loss_fn(p, t, base, mesh))(params, tokens)
+    loss_zig = jax.jit(lambda p, t: loss_fn(p, t, zig, mesh))(params, tokens)
+    np.testing.assert_allclose(
+        float(loss_base), float(loss_zig), rtol=1e-5, atol=1e-6
+    )
+
+    step = jax.jit(lambda p, t: sgd_train_step(p, t, config=zig, mesh=mesh))
+    new_params, loss = step(params, tokens)
+    assert np.isfinite(float(loss))
+    ref_params, _ = jax.jit(
+        lambda p, t: sgd_train_step(p, t, config=base, mesh=mesh)
+    )(params, tokens)
+    for a, b in zip(
+        jax.tree.leaves(new_params), jax.tree.leaves(ref_params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3
+        )
+
+
+def test_transformer_zigzag_with_flash_chunks():
+    """zigzag + flash chunks: the long-context TRAINING configuration —
+    balanced causal work, fused-kernel memory, full train step jitted."""
+    from torchsnapshot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+        sgd_train_step,
+        shard_params,
+    )
+
+    devices = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devices, ("dp", "sp", "tp"))
+    kw = dict(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq_len=128,
+    )
+    base = TransformerConfig(**kw)
+    zigflash = TransformerConfig(
+        **kw, ring_attention="zigzag", ring_chunk_impl="flash"
+    )
+    params = shard_params(init_params(base, jax.random.key(2)), mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(3), (2, 128), 0, 64),
+        NamedSharding(mesh, P("dp", "sp")),
+    )
+    loss_base = jax.jit(lambda p, t: loss_fn(p, t, base, mesh))(params, tokens)
+    loss_zf = jax.jit(lambda p, t: loss_fn(p, t, zigflash, mesh))(
+        params, tokens
+    )
+    np.testing.assert_allclose(
+        float(loss_base), float(loss_zf), rtol=1e-4, atol=1e-5
+    )
+    _, loss = jax.jit(
+        lambda p, t: sgd_train_step(p, t, config=zigflash, mesh=mesh)
+    )(params, tokens)
+    assert np.isfinite(float(loss))

@@ -69,5 +69,25 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, os.remove, os.path.join(self.root, path))
 
+    def _list_sync(self, prefix: str):
+        # Object-store semantics: a pure string prefix over relative
+        # paths. Walk only the plugin root — never its parent — so a
+        # sweep can only ever see this snapshot's own objects (walking
+        # dirname(root) for prefix="" would enumerate, and let sweep
+        # delete, sibling snapshots).
+        found = []
+        if not os.path.isdir(self.root):
+            return found
+        for dirpath, _, filenames in os.walk(self.root):
+            for name in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                if rel.startswith(prefix):
+                    found.append(rel)
+        return found
+
+    async def list_prefix(self, prefix: str):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._list_sync, prefix)
+
     def close(self) -> None:
         pass
