@@ -8,20 +8,37 @@ p4d.24xlarge against FSx Lustre — BASELINE.md); `vs_baseline` is measured
 GB/s over that.
 
 Prints exactly ONE JSON line:
-  {"metric": "snapshot_take_GBps", "value": N, "unit": "GB/s", "vs_baseline": N/0.44}
+  {"metric": "snapshot_take_GBps", "value": N, "unit": "GB/s",
+   "vs_baseline": N/0.44, "d2h_ceiling_GBps": ..., "take_vs_ceiling": ...,
+   "bench_bytes": ..., "async_stall_s": ..., "async_stall_pct": ...,
+   "restore_GBps": ...}
+
+The device here sits behind a SHARED tunnel whose bandwidth swings more
+than 30x with other tenants' traffic (measured 0.003–0.10 GB/s D2H on
+the same chip on the same day). Two consequences:
+
+- The benchmark CALIBRATES its payload size against a D2H probe so it
+  finishes in bounded wall-clock at any link speed (an explicitly set
+  TPUSNAPSHOT_BENCH_BYTES pins the size instead).
+- Absolute GB/s measures the tenancy as much as the code, so the JSON
+  also reports the probe ceiling and take/ceiling — the code-quality
+  ratio that is comparable across runs (VERDICT r1 #3 asks for take
+  >= ~85% of the concurrently measured ceiling, not of a number from a
+  different day's tenancy).
 
 Env knobs:
-  TPUSNAPSHOT_BENCH_BYTES          total parameter bytes (default 2 GiB)
+  TPUSNAPSHOT_BENCH_BYTES          total parameter bytes (default:
+                                   calibrated to ~45 s of take per run,
+                                   clamped to [64 MiB, 2 GiB])
   TPUSNAPSHOT_BENCH_RESTORE_BYTES  bytes restored in the restore timing
-                                   (default 512 MiB: restore is gated by
-                                   sustained H2D, ~0.01 GB/s through this
-                                   host's device tunnel, so a full-size
-                                   restore would dominate bench wall-clock
-                                   without changing the GB/s measurement)
+                                   (default: bench_bytes / 4 — restore
+                                   is gated by sustained H2D, the slower
+                                   direction of the tunnel)
   TPUSNAPSHOT_BENCH_DIR            target directory (default: fresh tmpdir)
 """
 
 import json
+import math
 import os
 import shutil
 import sys
@@ -35,35 +52,96 @@ import jax.numpy as jnp  # noqa: E402
 
 from torchsnapshot_tpu import Snapshot  # noqa: E402
 from torchsnapshot_tpu.models.ddp_synthetic import SyntheticModel  # noqa: E402
+from torchsnapshot_tpu.ops.transfer import parallel_device_get  # noqa: E402
 
 _REFERENCE_SINGLE_ACCEL_GBPS = 0.44
+_TARGET_TAKE_SECONDS = 45.0
+_MIN_BENCH_BYTES = 64 * 1024**2
+_MAX_BENCH_BYTES = 2 * 1024**3
+
+
+def _probe_d2h_gbps() -> float:
+    """Measure the current D2H ceiling with a 64 MB chunked gather.
+
+    Run twice; the first run also warms the slice-kernel compiles. The
+    better of the two is the ceiling (interference only subtracts).
+    """
+    arr = jax.random.normal(jax.random.key(7), (16 * 1024 * 1024,), jnp.float32)
+    jax.block_until_ready(arr)
+    best = 0.0
+    for _ in range(2):
+        begin = time.monotonic()
+        parallel_device_get(arr)
+        elapsed = time.monotonic() - begin
+        best = max(best, arr.nbytes / 1024**3 / elapsed)
+    return best
 
 
 def main() -> None:
-    total_bytes = int(os.environ.get("TPUSNAPSHOT_BENCH_BYTES", 2 * 1024**3))
-    param_bytes = min(100 * 1024 * 1024, total_bytes)
-    n_params = max(1, total_bytes // param_bytes)
-
-    model = SyntheticModel(
-        n_params=n_params, param_bytes=param_bytes, dtype=jnp.float32
-    )
-    jax.block_until_ready(list(model.params.values()))
-    nbytes = model.total_bytes()
+    env_bytes = os.environ.get("TPUSNAPSHOT_BENCH_BYTES")
+    d2h_gbps = _probe_d2h_gbps()
+    print(f"[bench] D2H probe ceiling: {d2h_gbps:.4f} GB/s", file=sys.stderr)
 
     bench_dir = os.environ.get("TPUSNAPSHOT_BENCH_DIR")
     own_dir = bench_dir is None
     if own_dir:
         bench_dir = tempfile.mkdtemp(prefix="tpusnapshot-bench-")
 
-    app_state = {"model": model}
     try:
         # Warm-up on one representative parameter to exclude one-time
         # costs (imports, thread pools, XLA compiles of the chunked-
-        # transfer slice kernels, first D2H) from the measured run.
-        warm = SyntheticModel(n_params=1, param_bytes=param_bytes)
+        # transfer slice kernels, first D2H) from the measured runs. The
+        # warmup take is also the calibration's realistic end-to-end
+        # speed sample: the raw probe alone can catch a momentarily
+        # quiet link and size a payload the next minute's tenancy cannot
+        # move in bounded time (observed: probe 0.0073 GB/s, take one
+        # minute later 0.0017 GB/s on the same chip).
+        warm_param_bytes = min(
+            100 * 1024 * 1024,
+            int(env_bytes) if env_bytes is not None else 100 * 1024 * 1024,
+        )
+        warm = SyntheticModel(n_params=1, param_bytes=warm_param_bytes)
+        warm_begin = time.monotonic()
         Snapshot.take(f"{bench_dir}/warmup", {"model": warm})
+        warm_elapsed = time.monotonic() - warm_begin
+        warm_gbps = warm_param_bytes / 1024**3 / warm_elapsed
+        print(
+            f"[bench] warmup take: {warm_elapsed:.2f}s "
+            f"({warm_gbps:.4f} GB/s end-to-end)",
+            file=sys.stderr,
+        )
         # Warm the async path too (on-device clone kernel compile).
         Snapshot.async_take(f"{bench_dir}/warmup-async", {"model": warm}).wait()
+
+        if env_bytes is not None:
+            total_bytes = int(env_bytes)
+        else:
+            # The warmup includes one-time costs, so ~1.3x its speed is a
+            # fair steady-state estimate; the probe bounds it above.
+            est_gbps = min(d2h_gbps, 1.3 * warm_gbps)
+            total_bytes = int(
+                min(
+                    _MAX_BENCH_BYTES,
+                    max(
+                        _MIN_BENCH_BYTES,
+                        est_gbps * 1024**3 * _TARGET_TAKE_SECONDS,
+                    ),
+                )
+            )
+        param_bytes = min(100 * 1024 * 1024, total_bytes)
+        n_params = max(1, total_bytes // param_bytes)
+
+        model = SyntheticModel(
+            n_params=n_params, param_bytes=param_bytes, dtype=jnp.float32
+        )
+        jax.block_until_ready(list(model.params.values()))
+        nbytes = model.total_bytes()
+        print(
+            f"[bench] payload: {nbytes / 1024**3:.2f} GiB "
+            f"({n_params} x {param_bytes >> 20} MiB)",
+            file=sys.stderr,
+        )
+        app_state = {"model": model}
 
         # Flush dirty pages so the measured run isn't throttled by a
         # previous run's writeback (reproducibility; the measured quantity
@@ -86,7 +164,19 @@ def main() -> None:
             begin = time.monotonic()
             Snapshot.take(f"{bench_dir}/snap", app_state)
             times.append(time.monotonic() - begin)
+            print(f"[bench] take run {i}: {times[-1]:.2f}s", file=sys.stderr)
         elapsed = sorted(times)[1]
+
+        # Re-probe ADJACENT to the timed loop and take the more generous
+        # of the two ceilings: tenancy drifting between the opening probe
+        # and the takes would otherwise dominate take_vs_ceiling (the one
+        # ratio meant to be comparable across runs).
+        d2h_gbps = max(d2h_gbps, _probe_d2h_gbps())
+        print(
+            f"[bench] D2H ceiling (max of pre/post probes): "
+            f"{d2h_gbps:.4f} GB/s",
+            file=sys.stderr,
+        )
 
         gbps = nbytes / (1024**3) / elapsed
 
@@ -99,7 +189,12 @@ def main() -> None:
         async_begin = time.monotonic()
         pending = Snapshot.async_take(f"{bench_dir}/snap-async", app_state)
         async_stall = time.monotonic() - async_begin
+        print(f"[bench] async stall: {async_stall:.3f}s", file=sys.stderr)
         pending.wait()
+        print(
+            f"[bench] async drain done: {time.monotonic() - async_begin:.2f}s",
+            file=sys.stderr,
+        )
 
         # Flush the async snapshot's dirty pages so restore reads don't
         # compete with its writeback.
@@ -114,9 +209,13 @@ def main() -> None:
         # restored arrays cannot produce a result until every byte has
         # landed in HBM (block_until_ready alone is not sufficient here).
         restore_bytes = int(
-            os.environ.get("TPUSNAPSHOT_BENCH_RESTORE_BYTES", 512 * 1024**2)
+            os.environ.get(
+                "TPUSNAPSHOT_BENCH_RESTORE_BYTES", total_bytes // 4
+            )
         )
-        n_restore = max(1, min(n_params, restore_bytes // param_bytes))
+        n_restore = max(
+            1, min(n_params, math.ceil(restore_bytes / param_bytes))
+        )
         restore_paths = [f"model/param_{i}" for i in range(n_restore)]
         target = SyntheticModel(n_params=1, param_bytes=1 << 20)
         target.params = {
@@ -138,12 +237,14 @@ def main() -> None:
         )
         restore_elapsed = time.monotonic() - restore_begin
         restored_gib = n_restore * param_bytes / 1024**3
+        restore_gbps = restored_gib / restore_elapsed
 
         print(
             f"[bench] {nbytes / 1024**3:.2f} GiB, take {elapsed:.2f}s "
-            f"({gbps:.2f} GB/s), restore[synced] {restored_gib:.2f} GiB "
-            f"in {restore_elapsed:.2f}s "
-            f"({restored_gib / restore_elapsed:.3f} GB/s), "
+            f"({gbps:.3f} GB/s = {100 * gbps / d2h_gbps:.0f}% of the "
+            f"{d2h_gbps:.3f} GB/s probe ceiling), "
+            f"restore[synced] {restored_gib:.2f} GiB in {restore_elapsed:.2f}s "
+            f"({restore_gbps:.3f} GB/s), "
             f"async stall {async_stall:.3f}s "
             f"({100 * async_stall / (elapsed + 1e-9):.1f}% of sync take)",
             file=sys.stderr,
@@ -155,6 +256,12 @@ def main() -> None:
                     "value": round(gbps, 3),
                     "unit": "GB/s",
                     "vs_baseline": round(gbps / _REFERENCE_SINGLE_ACCEL_GBPS, 2),
+                    "d2h_ceiling_GBps": round(d2h_gbps, 4),
+                    "take_vs_ceiling": round(gbps / d2h_gbps, 3),
+                    "bench_bytes": nbytes,
+                    "async_stall_s": round(async_stall, 3),
+                    "async_stall_pct": round(100 * async_stall / elapsed, 2),
+                    "restore_GBps": round(restore_gbps, 4),
                 }
             )
         )

@@ -1,0 +1,77 @@
+"""Summarize a snapshot Chrome trace into a phase breakdown.
+
+Reads the JSON written by ``TPUSNAPSHOT_TRACE=/path/trace.json`` (see
+torchsnapshot_tpu/tracing.py) and prints, per span name: count, total
+span-seconds, and — the number that matters for a pipelined schedule —
+the *busy wall-clock* (union of intervals), so "stage 18 s total but
+9 s busy" reads as 2x overlap. Use it to answer VERDICT-style "where
+does the take time go" questions from a file instead of a guess:
+
+    TPUSNAPSHOT_TRACE=/tmp/t.json python bench.py
+    python benchmarks/trace_report.py /tmp/t.json
+"""
+
+import json
+import sys
+from collections import defaultdict
+
+
+def union_seconds(intervals):
+    total = 0.0
+    end = None
+    for b, e in sorted(intervals):
+        if end is None or b > end:
+            total += e - b
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    with open(sys.argv[1]) as f:
+        events = json.load(f)["traceEvents"]
+
+    begins = {}
+    spans = defaultdict(list)  # name -> [(begin_us, end_us)]
+    bytes_by_name = defaultdict(int)
+    for ev in events:
+        if ev["ph"] == "b":
+            begins[ev["id"]] = ev
+        elif ev["ph"] == "e":
+            b = begins.pop(ev["id"], None)
+            if b is None:
+                continue
+            spans[b["name"]].append((b["ts"], ev["ts"]))
+            args = b.get("args") or {}
+            if isinstance(args.get("bytes"), int):
+                bytes_by_name[b["name"]] += args["bytes"]
+
+    if not spans:
+        print("no spans found")
+        return 1
+    t0 = min(b for ivs in spans.values() for b, _ in ivs)
+    t1 = max(e for ivs in spans.values() for _, e in ivs)
+    print(f"trace wall-clock: {(t1 - t0) / 1e6:.2f}s")
+    print(f"{'span':20s} {'count':>6s} {'total_s':>9s} {'busy_s':>8s} "
+          f"{'overlap':>7s} {'GB':>7s} {'GB/s(busy)':>10s}")
+    for name, ivs in sorted(
+        spans.items(), key=lambda kv: -sum(e - b for b, e in kv[1])
+    ):
+        total = sum(e - b for b, e in ivs) / 1e6
+        busy = union_seconds(ivs) / 1e6
+        gb = bytes_by_name[name] / 1024**3
+        rate = f"{gb / busy:10.3f}" if gb and busy else " " * 10
+        print(
+            f"{name:20s} {len(ivs):6d} {total:9.2f} {busy:8.2f} "
+            f"{total / busy if busy else 0:6.1f}x {gb:7.2f} {rate}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,12 +67,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="delete the snapshot (metadata first, then all payloads)",
     )
+    parser.add_argument(
+        "--sweep",
+        action="store_true",
+        help="with --delete: also enumerate the prefix and remove orphans "
+        "from interrupted takes (works even without a metadata document)",
+    )
     args = parser.parse_args(argv)
 
     if args.delete:
-        Snapshot(args.path).delete()
-        print(f"deleted {args.path}")
+        Snapshot(args.path).delete(sweep=args.sweep)
+        print(f"deleted {args.path}" + (" (swept)" if args.sweep else ""))
         return 0
+    if args.sweep:
+        parser.error("--sweep requires --delete")
 
     manifest = Snapshot(args.path).get_manifest()
     view = manifest if args.raw else get_available_entries(manifest, args.rank)
