@@ -130,6 +130,18 @@ def main() -> None:
             )
         param_bytes = min(100 * 1024 * 1024, total_bytes)
         n_params = max(1, total_bytes // param_bytes)
+        if param_bytes != warm_param_bytes:
+            # Calibration picked a different parameter shape than the
+            # warmup used; warm the new shape's compiles — slice kernels
+            # (sync take) AND the on-device clone (async take, whose
+            # single stall measurement would otherwise pay first-compile).
+            rewarm = SyntheticModel(
+                n_params=1, param_bytes=param_bytes, seed=2
+            )
+            Snapshot.take(f"{bench_dir}/warmup2", {"model": rewarm})
+            Snapshot.async_take(
+                f"{bench_dir}/warmup2-async", {"model": rewarm}
+            ).wait()
 
         model = SyntheticModel(
             n_params=n_params, param_bytes=param_bytes, dtype=jnp.float32
@@ -272,6 +284,8 @@ def main() -> None:
             shutil.rmtree(f"{bench_dir}/snap", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/snap-async", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/warmup2", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/warmup2-async", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup-async", ignore_errors=True)
 
 

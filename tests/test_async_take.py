@@ -229,3 +229,128 @@ def test_many_small_leaves_round_trip(tmp_path):
     assert float(target["k1999"][0, 0]) == 1999.0
     assert float(target["k0000"][0, 0]) == 0.0
     assert len(Snapshot(path).get_manifest()) >= 2000
+
+
+def test_failed_take_leaves_no_commit_and_sweep_recovers(tmp_path):
+    """Crash-recovery story: a take that dies mid-write must leave the
+    path UNCOMMITTED (no metadata document -> restore raises not-found)
+    with its partial writes stranded, a subsequent take to the same path
+    must succeed, and delete(sweep=True) then leaves nothing behind
+    (orphan-specific collection is covered by the delete-sweep tests)."""
+    import os
+    import threading
+
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    path = str(tmp_path / "snap")
+    state = StateDict(
+        a=jnp.arange(64, dtype=jnp.float32),
+        b=jnp.ones((32,), dtype=jnp.float32),
+    )
+
+    real_write = FSStoragePlugin._write_sync
+    writes = []
+    write_lock = threading.Lock()
+
+    def dying_write(self, io_req):
+        # Decide under a lock BEFORE writing: with 2-way write
+        # concurrency both writers could otherwise observe len==2 and
+        # raise, leaving zero partial writes to recover from. This way
+        # write #1 always lands (asyncio.run joins the default executor
+        # on teardown) and write #2 always dies.
+        with write_lock:
+            writes.append(io_req.path)
+            n = len(writes)
+        if n == 2:
+            raise OSError("disk gone")
+        real_write(self, io_req)
+
+    FSStoragePlugin._write_sync = dying_write
+    try:
+        # Storage retries would mask the injected failure; disable.
+        os.environ["TPUSNAPSHOT_STORAGE_RETRIES"] = "0"
+        with pytest.raises(OSError, match="disk gone"):
+            Snapshot.take(path, {"s": state})
+    finally:
+        FSStoragePlugin._write_sync = real_write
+        os.environ.pop("TPUSNAPSHOT_STORAGE_RETRIES", None)
+
+    # The crash stranded at least write #1's object, uncommitted:
+    # metadata absent, restore refuses.
+    stranded = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert stranded, "the failed take should have landed a partial write"
+    with pytest.raises(FileNotFoundError):
+        Snapshot(path).restore({"s": StateDict(a=jnp.zeros(64), b=jnp.zeros(32))})
+
+    # The same path takes cleanly afterwards (fresh take overwrites), and
+    # the snapshot round-trips.
+    Snapshot.take(path, {"s": state})
+    target = StateDict(
+        a=jnp.zeros(64, dtype=jnp.float32), b=jnp.zeros(32, dtype=jnp.float32)
+    )
+    Snapshot(path).restore({"s": target})
+    np.testing.assert_array_equal(np.asarray(target["a"]), np.asarray(state["a"]))
+
+    # Sweep-delete collects everything, including any orphan of the
+    # failed attempt.
+    Snapshot(path).delete(sweep=True)
+    leftovers = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert leftovers == []
+
+
+def test_stale_async_commit_cannot_satisfy_new_take(tmp_path):
+    """take_id nonces: a pending wait() for take B must not accept take
+    A's already-committed metadata at the same path (the marker/metadata
+    poll matches on the nonce, not mere existence). Take B's metadata
+    commit is artificially delayed, so an existence-based poll WOULD
+    return early — while only A's document exists — and the
+    nonce-at-wait-return assertion below would catch it."""
+    import os
+    import threading
+    import time as _time
+
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    path = str(tmp_path / "snap")
+    a = StateDict(x=jnp.zeros(8))
+    b = StateDict(x=jnp.ones(8))
+
+    def read_meta():
+        with open(os.path.join(path, SNAPSHOT_METADATA_FNAME)) as f:
+            return SnapshotMetadata.from_yaml(f.read())
+
+    Snapshot.async_take(path, {"s": a}).wait()
+    meta_a = read_meta()
+
+    real_write = FSStoragePlugin._write_sync
+    delay_metadata = threading.Event()
+    delay_metadata.set()
+
+    def slow_metadata_write(self, io_req):
+        if delay_metadata.is_set() and io_req.path == SNAPSHOT_METADATA_FNAME:
+            _time.sleep(0.5)
+        real_write(self, io_req)
+
+    FSStoragePlugin._write_sync = slow_metadata_write
+    try:
+        pending_b = Snapshot.async_take(path, {"s": b})
+        nonce_b = pending_b._background.take_id
+        assert nonce_b and nonce_b != meta_a.take_id
+        pending_b.wait()
+        # At the instant wait() returns, the visible metadata must
+        # already be B's — an existence-based poll would have returned
+        # ~0.5 s earlier with A's document still in place.
+        meta_at_return = read_meta()
+        assert meta_at_return.take_id == nonce_b
+    finally:
+        FSStoragePlugin._write_sync = real_write
+
+    target = StateDict(x=jnp.full((8,), 7.0))
+    Snapshot(path).restore({"s": target})
+    np.testing.assert_array_equal(np.asarray(target["x"]), np.ones(8))

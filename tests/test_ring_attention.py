@@ -508,3 +508,53 @@ def test_transformer_zigzag_with_flash_chunks():
         lambda p, t: sgd_train_step(p, t, config=zigflash, mesh=mesh)
     )(params, tokens)
     assert np.isfinite(float(loss))
+
+
+def test_zigzag_helpers_seq_axis():
+    """to_zigzag/from_zigzag work for non-attention layouts: [B, S]
+    tokens and [B, S, V] logits via seq_axis=1 (inference callers
+    un-permute zigzag logits with this)."""
+    from torchsnapshot_tpu.parallel.ring_attention import (
+        from_zigzag,
+        to_zigzag,
+    )
+
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    tokens = jax.random.randint(jax.random.key(0), (2, 64), 0, 100)
+    z = to_zigzag(tokens, mesh, seq_axis=1)
+    assert z.sharding.spec[1] == "sp"
+    back = from_zigzag(z, mesh, seq_axis=1)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(tokens))
+
+    logits = jax.random.normal(jax.random.key(1), (2, 64, 16))
+    back2 = from_zigzag(
+        to_zigzag(logits, mesh, seq_axis=1), mesh, seq_axis=1
+    )
+    np.testing.assert_array_equal(np.asarray(back2), np.asarray(logits))
+
+
+def test_async_timeout_names_all_missing_ranks(tmp_path):
+    """_collect_completion_manifests' timeout error enumerates every
+    straggler rank, not just the first missing one."""
+    import asyncio
+
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+    from torchsnapshot_tpu.io_types import IOReq
+    from torchsnapshot_tpu.snapshot import _collect_completion_manifests
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    storage = MemoryStoragePlugin()
+    nonce = "abc123"
+    # Ranks 0 and 2 completed; 1 and 3 never did.
+    for r in (0, 2):
+        doc = SnapshotMetadata(
+            version="v", world_size=4, manifest={}, take_id=nonce
+        ).to_yaml()
+        req = IOReq(path=f".completed/{nonce}/{r}")
+        req.buf.write(doc.encode())
+        asyncio.run(storage.write(req))
+
+    with pytest.raises(TimeoutError, match=r"rank\(s\) \[1, 3\]"):
+        asyncio.run(
+            _collect_completion_manifests(storage, 4, nonce, timeout_s=0.3)
+        )

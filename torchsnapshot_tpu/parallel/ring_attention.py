@@ -75,9 +75,12 @@ def _merge(acc, o, m_new, l_new):
     return (o_run * alpha + o * beta, m, l_run * alpha + l_new * beta)
 
 
-def _infer_spec_padded(x: jax.Array, spec: Optional[P]) -> Optional[P]:
+def _infer_spec_padded(
+    x: jax.Array, spec: Optional[P], ndim: int = 4
+) -> Optional[P]:
     """``spec`` if given, else the array's NamedSharding spec, padded to
-    4 entries; None when unavailable (e.g. tracers hide ``.sharding``)."""
+    ``ndim`` entries; None when unavailable (e.g. tracers hide
+    ``.sharding``)."""
     if spec is None:
         try:
             sharding = x.sharding
@@ -87,7 +90,7 @@ def _infer_spec_padded(x: jax.Array, spec: Optional[P]) -> Optional[P]:
             spec = sharding.spec
     if spec is None:
         return None
-    return P(*(tuple(spec) + (None,) * (4 - len(spec))))
+    return P(*(tuple(spec) + (None,) * (ndim - len(spec))))
 
 
 def _resolve_spec(
@@ -268,41 +271,50 @@ def zigzag_indices(s: int, n: int) -> jnp.ndarray:
 
 
 def _zigzag_target_spec(
-    x: jax.Array, axis: str, spec: Optional[P]
+    x: jax.Array, axis: str, spec: Optional[P], seq_axis: int
 ) -> P:
     """Keep the input's batch/head shardings (a bare seq-only spec would
     silently all-gather a dp-sharded batch); only the sequence dim is
     forced onto `axis`. Pass ``spec`` explicitly under jit/grad (tracers
     hide ``.sharding`` and the fallback would drop the batch sharding)."""
-    inferred = _infer_spec_padded(x, spec)
-    if inferred is None:
-        return P(None, None, axis, None)
-    entries = list(inferred)
-    entries[2] = axis
+    inferred = _infer_spec_padded(x, spec, ndim=x.ndim)
+    entries = [None] * x.ndim if inferred is None else list(inferred)
+    entries[seq_axis] = axis
     return P(*entries)
 
 
 def to_zigzag(
-    x: jax.Array, mesh: Mesh, axis: str = "sp", spec: Optional[P] = None
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    spec: Optional[P] = None,
+    seq_axis: int = 2,
 ) -> jax.Array:
-    """Permute [B, H, S, D] into zigzag order and shard the sequence dim
-    over `axis` (other dims keep their shardings)."""
-    idx = zigzag_indices(x.shape[2], mesh.shape[axis])
-    target = _zigzag_target_spec(x, axis, spec)
+    """Permute ``x`` into zigzag order along its sequence dimension and
+    shard that dim over `axis` (other dims keep their shardings).
+
+    ``seq_axis`` defaults to 2 ([B, H, S, D] attention tensors); pass 1
+    for [B, S]-shaped tokens or [B, S, V] logits."""
+    idx = zigzag_indices(x.shape[seq_axis], mesh.shape[axis])
+    target = _zigzag_target_spec(x, axis, spec, seq_axis)
     return jax.device_put(
-        jnp.take(x, idx, axis=2), NamedSharding(mesh, target)
+        jnp.take(x, idx, axis=seq_axis), NamedSharding(mesh, target)
     )
 
 
 def from_zigzag(
-    x: jax.Array, mesh: Mesh, axis: str = "sp", spec: Optional[P] = None
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    spec: Optional[P] = None,
+    seq_axis: int = 2,
 ) -> jax.Array:
     """Invert :func:`to_zigzag` (shardings preserved)."""
-    idx = zigzag_indices(x.shape[2], mesh.shape[axis])
+    idx = zigzag_indices(x.shape[seq_axis], mesh.shape[axis])
     inv = jnp.argsort(idx)
-    target = _zigzag_target_spec(x, axis, spec)
+    target = _zigzag_target_spec(x, axis, spec, seq_axis)
     return jax.device_put(
-        jnp.take(x, inv, axis=2), NamedSharding(mesh, target)
+        jnp.take(x, inv, axis=seq_axis), NamedSharding(mesh, target)
     )
 
 

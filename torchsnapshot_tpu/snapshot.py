@@ -876,9 +876,32 @@ async def _collect_completion_manifests(
                 manifests.append(marker.manifest)
                 break
             if _time.monotonic() > deadline:
+                # One non-polling sweep over the ranks not yet checked, so
+                # the error names EVERY straggler (at pod scale "rank 17
+                # and 40-63 are missing" localizes the failure; "rank 17"
+                # alone does not). A rank counts as complete only under
+                # the same parse-and-nonce validation as the poll above —
+                # a partially-visible or stale marker is NOT completion.
+                missing = [r]
+                for r2 in range(r + 1, world_size):
+                    try:
+                        probe = IOReq(path=f".completed/{nonce}/{r2}")
+                        await storage.read(probe)
+                        candidate = SnapshotMetadata.from_yaml(
+                            bytes(io_payload(probe)).decode(
+                                "utf-8", errors="replace"
+                            )
+                        )
+                        if candidate.take_id != nonce:
+                            missing.append(r2)
+                    except Exception:
+                        missing.append(r2)
                 raise TimeoutError(
-                    f"Timed out waiting for rank {r}'s snapshot writes "
-                    f"to complete (marker {path} absent or stale)."
+                    f"Timed out waiting for snapshot writes to complete: "
+                    f"rank(s) {missing} never wrote their completion "
+                    f"markers (.completed/{nonce}/<rank>). Those processes "
+                    f"likely crashed or stalled mid-take; the snapshot is "
+                    f"NOT committed."
                 )
             await asyncio.sleep(delay)
             delay = min(delay * 2, 1.0)
