@@ -839,6 +839,31 @@ async def _delete_ignore_missing(storage: StoragePlugin, path: str) -> None:
 _is_not_found_error = is_not_found_error
 
 
+async def _read_valid_marker(
+    storage: StoragePlugin, path: str, nonce: str, strict_errors: bool
+) -> Optional[SnapshotMetadata]:
+    """Read a completion marker and validate it: parseable AND carrying
+    this take's nonce. A partially-visible document (non-atomic storage
+    visibility) parses as garbage, and a marker from a previous take
+    carries a stale take_id — both count as "not completed", same as
+    ``_wait_for_metadata``. ``strict_errors`` re-raises storage errors
+    other than not-found (the polling caller must surface them);
+    non-strict treats any failure as "no valid marker" (the diagnostic
+    sweep must not die mid-report)."""
+    try:
+        io_req = IOReq(path=path)
+        await storage.read(io_req)
+        candidate = SnapshotMetadata.from_yaml(
+            bytes(io_payload(io_req)).decode("utf-8", errors="replace")
+        )
+        if candidate.take_id == nonce:
+            return candidate
+    except Exception as e:
+        if strict_errors and not _is_not_found_error(e):
+            raise
+    return None
+
+
 async def _collect_completion_manifests(
     storage: StoragePlugin,
     world_size: int,
@@ -855,23 +880,9 @@ async def _collect_completion_manifests(
         path = f".completed/{nonce}/{r}"
         delay = 0.02
         while True:
-            marker: Optional[SnapshotMetadata] = None
-            try:
-                io_req = IOReq(path=path)
-                await storage.read(io_req)
-                doc = bytes(io_payload(io_req)).decode("utf-8", errors="replace")
-                try:
-                    # A partially-visible document (non-atomic storage
-                    # visibility) parses as garbage or carries a stale
-                    # take_id: keep polling, same as _wait_for_metadata.
-                    candidate = SnapshotMetadata.from_yaml(doc)
-                    if candidate.take_id == nonce:
-                        marker = candidate
-                except Exception:
-                    marker = None
-            except Exception as e:
-                if not _is_not_found_error(e):
-                    raise
+            marker = await _read_valid_marker(
+                storage, path, nonce, strict_errors=True
+            )
             if marker is not None:
                 manifests.append(marker.manifest)
                 break
@@ -879,29 +890,26 @@ async def _collect_completion_manifests(
                 # One non-polling sweep over the ranks not yet checked, so
                 # the error names EVERY straggler (at pod scale "rank 17
                 # and 40-63 are missing" localizes the failure; "rank 17"
-                # alone does not). A rank counts as complete only under
-                # the same parse-and-nonce validation as the poll above —
-                # a partially-visible or stale marker is NOT completion.
+                # alone does not), under the same validation as the poll.
                 missing = [r]
                 for r2 in range(r + 1, world_size):
-                    try:
-                        probe = IOReq(path=f".completed/{nonce}/{r2}")
-                        await storage.read(probe)
-                        candidate = SnapshotMetadata.from_yaml(
-                            bytes(io_payload(probe)).decode(
-                                "utf-8", errors="replace"
-                            )
+                    if (
+                        await _read_valid_marker(
+                            storage,
+                            f".completed/{nonce}/{r2}",
+                            nonce,
+                            strict_errors=False,
                         )
-                        if candidate.take_id != nonce:
-                            missing.append(r2)
-                    except Exception:
+                        is None
+                    ):
                         missing.append(r2)
                 raise TimeoutError(
                     f"Timed out waiting for snapshot writes to complete: "
-                    f"rank(s) {missing} never wrote their completion "
-                    f"markers (.completed/{nonce}/<rank>). Those processes "
-                    f"likely crashed or stalled mid-take; the snapshot is "
-                    f"NOT committed."
+                    f"rank(s) {missing} have no valid completion marker "
+                    f"(.completed/{nonce}/<rank> absent, unreadable, or "
+                    f"stale from a previous take). Those processes likely "
+                    f"crashed or stalled mid-take; the snapshot is NOT "
+                    f"committed."
                 )
             await asyncio.sleep(delay)
             delay = min(delay * 2, 1.0)
