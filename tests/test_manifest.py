@@ -245,3 +245,102 @@ def test_manifest_scales_to_7b_fsdp_shape():
     assert dump_s < 6.0, f"to_yaml took {dump_s:.2f}s"
     assert parse_s < 10.0, f"from_yaml took {parse_s:.2f}s"
     assert avail_s < 4.0, f"get_available_entries took {avail_s:.2f}s"
+
+
+def test_metadata_doc_compression_round_trip(tmp_path, monkeypatch):
+    """Metadata documents above the threshold store zlib-compressed
+    (leading byte 0x78 vs '{' — formats cannot collide) and read back
+    transparently; small documents stay plain; both restore fine.
+    Completion markers share the codec."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import torchsnapshot_tpu.snapshot as snapmod
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.snapshot import (
+        SNAPSHOT_METADATA_FNAME,
+        _decode_metadata_doc,
+        _encode_metadata_doc,
+    )
+
+    # Helper-level round trip at both sizes.
+    small = '{"version": "x"}'
+    assert _encode_metadata_doc(small) == small.encode()
+    big = '{"manifest": "' + "y" * (2 << 20) + '"}'
+    enc = _encode_metadata_doc(big)
+    assert enc[:1] == b"\x78" and len(enc) < len(big)
+    assert _decode_metadata_doc(enc) == big
+    assert _decode_metadata_doc(small.encode()) == small
+
+    # End-to-end with the threshold forced low: the stored metadata (and
+    # async markers) are compressed on disk, everything still works.
+    monkeypatch.setattr(snapmod, "_METADATA_COMPRESS_THRESHOLD", 64)
+    state = StateDict(w=jnp.arange(128, dtype=jnp.float32))
+    path = str(tmp_path / "snap")
+    Snapshot.async_take(path, {"s": state}).wait()
+    raw = (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).read_bytes()
+    assert raw[:1] == b"\x78"  # compressed on disk
+
+    target = StateDict(w=jnp.zeros(128, dtype=jnp.float32))
+    Snapshot(path).restore({"s": target})
+    np.testing.assert_array_equal(np.asarray(target["w"]), np.arange(128))
+
+    # Uncompressed legacy documents still read (plain take below the
+    # restored threshold).
+    monkeypatch.setattr(snapmod, "_METADATA_COMPRESS_THRESHOLD", 1 << 20)
+    path2 = str(tmp_path / "snap2")
+    Snapshot.take(path2, {"s": state})
+    raw2 = (tmp_path / "snap2" / SNAPSHOT_METADATA_FNAME).read_bytes()
+    assert raw2[:1] == b"{"
+    target2 = StateDict(w=jnp.zeros(128, dtype=jnp.float32))
+    Snapshot(path2).restore({"s": target2})
+    np.testing.assert_array_equal(np.asarray(target2["w"]), np.arange(128))
+
+
+def test_torn_compressed_metadata_keeps_polling(tmp_path):
+    """A partially-visible COMPRESSED metadata document must read as
+    'not committed yet' in the polling paths (zlib.error == torn), and
+    fail loudly in the strict committed-read path (code-review r2)."""
+    import asyncio
+
+    import zlib
+
+    import pytest as _pytest
+
+    from torchsnapshot_tpu.snapshot import (
+        _decode_metadata_doc,
+        _read_valid_marker,
+        _wait_for_metadata,
+    )
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+    from torchsnapshot_tpu.io_types import IOReq
+
+    full = zlib.compress(b'{"version": "v", "world_size": 1, "manifest": {}}', 1)
+    torn = full[: len(full) // 2]
+    assert torn[:1] == b"\x78"
+
+    # Strict decode raises at the corruption.
+    with _pytest.raises(zlib.error):
+        _decode_metadata_doc(torn)
+
+    storage = MemoryStoragePlugin()
+    req = IOReq(path=".snapshot_metadata")
+    req.buf.write(torn)
+    asyncio.run(storage.write(req))
+    req2 = IOReq(path=".completed/n/0")
+    req2.buf.write(torn)
+    asyncio.run(storage.write(req2))
+
+    # Polling paths treat torn-compressed as "keep waiting" (timeout,
+    # not a zlib crash).
+    with _pytest.raises(TimeoutError):
+        asyncio.run(_wait_for_metadata(storage, take_id="n", timeout_s=0.2))
+    assert (
+        asyncio.run(
+            _read_valid_marker(
+                storage, ".completed/n/0", "n", strict_errors=True
+            )
+        )
+        is None
+    )
