@@ -150,3 +150,77 @@ def test_transformer_flash_rejects_sub_mxu_blocks():
     tokens = jax.random.randint(jax.random.key(1), (1, 132), 0, 64)
     with pytest.raises(ValueError, match="power-of-two factor"):
         forward(params, tokens, cfg)  # gcd(132,128)=4 < 8
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_matches_repeated_kv_reference(causal):
+    """Grouped-query attention: Hq = 8 query heads share Hkv = 2 kv
+    heads, expressed purely through kernel index maps (K/V never
+    materialize per q-head). Reference: dense attention with kv heads
+    repeated group-fold."""
+    from torchsnapshot_tpu.ops.attention import (
+        _reference_attention,
+        flash_attention,
+    )
+
+    b, hq, hkv, s, d = 2, 8, 2, 64, 16
+    kq, kk, kv = jax.random.split(jax.random.key(31), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    expected = _reference_attention(
+        q, jnp.repeat(k, hq // hkv, axis=1), jnp.repeat(v, hq // hkv, axis=1),
+        causal,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=3e-6, rtol=1e-5
+    )
+
+
+def test_flash_gqa_gradients_match_repeated_kv_reference():
+    """GQA backward: dq per q-head; dk/dv group-summed onto the shared
+    kv heads — equal to differentiating the repeat-kv dense reference
+    (jnp.repeat's VJP is exactly the group sum)."""
+    from torchsnapshot_tpu.ops.attention import (
+        _reference_attention,
+        flash_attention,
+    )
+
+    b, hq, hkv, s, d = 1, 4, 2, 32, 8
+    kq, kk, kv = jax.random.split(jax.random.key(33), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=8, block_k=8) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        g = hq // hkv
+        return jnp.sum(
+            _reference_attention(
+                q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1), True
+            )
+            ** 2
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        assert a.shape == b_.shape
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-4, rtol=1e-4
+        )
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    from torchsnapshot_tpu.ops.attention import flash_attention
+
+    q = jnp.zeros((1, 6, 16, 8))
+    k = jnp.zeros((1, 4, 16, 8))
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, k, causal=True, block_q=8, block_k=8)

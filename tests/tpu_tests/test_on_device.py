@@ -173,3 +173,40 @@ def test_flash_chunk_vjp_on_device():
             atol=0.15,  # bf16 inputs; kernel accumulates f32
             rtol=0.05,
         )
+
+
+def test_flash_gqa_on_device():
+    """GQA index maps lower under Mosaic: 8 q heads sharing 2 kv heads,
+    forward + gradients on real TPU vs the repeat-kv einsum reference."""
+    from torchsnapshot_tpu.ops.attention import (
+        _reference_attention,
+        flash_attention,
+    )
+
+    b, hq, hkv, s, d = 1, 8, 2, 512, 64
+    kq, kk, kv = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+
+    out = flash_attention(q, k, v, causal=True)
+    g = hq // hkv
+    expected = _reference_attention(
+        q.astype(jnp.float32),
+        jnp.repeat(k, g, axis=1).astype(jnp.float32),
+        jnp.repeat(v, g, axis=1).astype(jnp.float32),
+        True,
+    )
+    err = float(jnp.abs(out.astype(jnp.float32) - expected).max())
+    assert err < 2e-2, err
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2
+        )
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(grads)
+    assert grads[1].shape == (b, hkv, s, d)
+    for gr in grads:
+        assert bool(jnp.isfinite(gr.astype(jnp.float32)).all())

@@ -44,6 +44,12 @@ class TransformerConfig:
     vocab_size: int = 256
     d_model: int = 128
     n_heads: int = 4
+    # Key/value heads (grouped-query attention): n_heads % n_kv_heads
+    # == 0; q-head h attends kv-head h // group. None = n_heads (dense
+    # MHA). Under ring attention the K/V slices that rotate over ICI
+    # shrink by the group factor — GQA is a long-context communication
+    # optimization, not just a KV-cache one.
+    n_kv_heads: Any = None
     n_layers: int = 2
     d_ff: int = 512
     max_seq_len: int = 128
@@ -74,6 +80,20 @@ class TransformerConfig:
     ring_chunk_impl: str = "einsum"
 
 
+def _n_kv_heads(config: "TransformerConfig") -> int:
+    """Normalized kv-head count: None = dense MHA; 0 or a non-divisor of
+    n_heads is a configuration error, not a silent fallback."""
+    n_kv = config.n_kv_heads
+    if n_kv is None:
+        return config.n_heads
+    if n_kv <= 0 or config.n_heads % n_kv:
+        raise ValueError(
+            f"n_heads ({config.n_heads}) must be a positive multiple of "
+            f"n_kv_heads ({n_kv})"
+        )
+    return n_kv
+
+
 def _ring_mode(config: "TransformerConfig") -> Optional[str]:
     """Normalize config.ring_attention to None | "contiguous" | "zigzag"."""
     r = config.ring_attention
@@ -96,6 +116,8 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             config.dtype
         )
 
+    n_kv = _n_kv_heads(config)
+    kv_dim = (config.d_model // config.n_heads) * n_kv
     layers = []
     for i in range(config.n_layers):
         lk = jax.random.split(keys[i], 6)
@@ -103,8 +125,8 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             {
                 "attn": {
                     "wq": dense(lk[0], (config.d_model, config.d_model)),
-                    "wk": dense(lk[1], (config.d_model, config.d_model)),
-                    "wv": dense(lk[2], (config.d_model, config.d_model)),
+                    "wk": dense(lk[1], (config.d_model, kv_dim)),
+                    "wv": dense(lk[2], (config.d_model, kv_dim)),
                     "wo": dense(lk[3], (config.d_model, config.d_model)),
                 },
                 "mlp": {
@@ -216,6 +238,7 @@ def forward(
         else jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
     )
     head_dim = config.d_model // config.n_heads
+    n_kv_heads = _n_kv_heads(config)
 
     for layer in params["layers"]:
         x = _layer_norm(h, layer["ln1"])
@@ -223,8 +246,8 @@ def forward(
         k = jnp.einsum("bsd,dh->bsh", x, layer["attn"]["wk"])
         v = jnp.einsum("bsd,dh->bsh", x, layer["attn"]["wv"])
         q = q.reshape(*q.shape[:2], config.n_heads, head_dim)
-        k = k.reshape(*k.shape[:2], config.n_heads, head_dim)
-        v = v.reshape(*v.shape[:2], config.n_heads, head_dim)
+        k = k.reshape(*k.shape[:2], n_kv_heads, head_dim)
+        v = v.reshape(*v.shape[:2], n_kv_heads, head_dim)
         if config.flash_attention:
             block = resolve_flash_block(seq_len)
             attn = flash_attention(
@@ -244,7 +267,9 @@ def forward(
             names = mesh.axis_names
             head_axis = (
                 "tp"
-                if "tp" in names and config.n_heads % mesh.shape["tp"] == 0
+                if "tp" in names
+                and config.n_heads % mesh.shape["tp"] == 0
+                and n_kv_heads % mesh.shape["tp"] == 0
                 else None
             )
             ring_spec = P(
@@ -264,6 +289,13 @@ def forward(
                     spec=ring_spec, chunk_impl=config.ring_chunk_impl,
                 ).transpose(0, 2, 1, 3)
         else:
+            if n_kv_heads != config.n_heads:
+                # Dense einsum is the numerical reference path; repeating
+                # kv heads is the textbook GQA semantics (the kernels
+                # avoid the materialization; this path keeps it simple).
+                group = config.n_heads // n_kv_heads
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(head_dim)
             scores = jnp.where(mask[None, None, :, :], scores, -1e30)
             probs = jax.nn.softmax(

@@ -349,21 +349,25 @@ def _resolve_blocks(s: int, block_q: int, block_k: int):
 )
 def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret):
     b, h, s, d = q.shape
+    group = _gqa_group(q, k)
+    hkv = h // group
     block_q, block_k = _resolve_blocks(s, block_q, block_k)
     scale = 1.0 / (d**0.5)
     bh = b * h
-    flat = lambda x: x.reshape(bh, s, x.shape[-1])  # noqa: E731
+    flat = lambda x: x.reshape(-1, s, x.shape[-1])  # noqa: E731
     qf, kf, vf, gf = flat(q), flat(k), flat(v), flat(g)
     lsef, deltaf = lse.reshape(bh, s, 1), delta.reshape(bh, s, 1)
 
     # Two index maps cover both grids: "block index is grid axis 1" vs
     # "grid axis 2". dq's grid is (bh, q, k); dk/dv's is (bh, k, q) — the
     # q-indexed operands ride axis 1 in the first and axis 2 in the
-    # second, and vice versa for k-indexed ones.
+    # second, and vice versa for k-indexed ones. Under GQA the k-indexed
+    # operands additionally collapse the q-head to its kv-head.
     by_axis1 = lambda bh_, a, b_: (bh_, a, 0)  # noqa: E731
     by_axis2 = lambda bh_, a, b_: (bh_, b_, 0)  # noqa: E731
+    kv1 = _kv_index_map(h, group)  # k-operand indexed by grid axis 2
     row_q = pl.BlockSpec((1, block_q, d), by_axis1)
-    row_k = pl.BlockSpec((1, block_k, d), by_axis2)
+    row_k = pl.BlockSpec((1, block_k, d), kv1)
     aux_q = pl.BlockSpec((1, block_q, 1), by_axis1)
 
     dq = pl.pallas_call(
@@ -380,8 +384,13 @@ def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
     )(qf, kf, vf, gf, lsef, deltaf)
 
     # dk/dv grid swaps the roles: k-block outer (axis 1), q-block inner.
+    # Under GQA the kernel runs per Q-head (each contributes to its
+    # kv-head's gradient); the per-q-head partials are group-summed after
+    # the call — one transient [B,Hq,S,D] f32 pair, the same footprint as
+    # the incoming cotangent, in exchange for unchanged kernel code.
     row_q2 = pl.BlockSpec((1, block_q, d), by_axis2)
-    row_k2 = pl.BlockSpec((1, block_k, d), by_axis1)
+    row_k2 = pl.BlockSpec((1, block_k, d), _kv_index_map(h, group, block_axis=1))
+    out_k2 = pl.BlockSpec((1, block_k, d), by_axis1)
     aux_q2 = pl.BlockSpec((1, block_q, 1), by_axis2)
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -394,7 +403,7 @@ def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
         ),
         grid=(bh, s // block_k, s // block_q),
         in_specs=[row_q2, row_k2, row_k2, row_q2, aux_q2, aux_q2],
-        out_specs=(row_k2, row_k2),
+        out_specs=(out_k2, out_k2),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -402,8 +411,12 @@ def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
         interpret=interpret,
     )(qf, kf, vf, gf, lsef, deltaf)
 
-    unflat = lambda x: x.reshape(b, h, s, d)  # noqa: E731
-    return unflat(dq), unflat(dk), unflat(dv)
+    dq = dq.reshape(b, h, s, d)
+    if group == 1:
+        return dq, dk.reshape(b, hkv, s, d), dv.reshape(b, hkv, s, d)
+    dk = dk.reshape(b, hkv, group, s, d).sum(axis=2)
+    dv = dv.reshape(b, hkv, group, s, d).sum(axis=2)
+    return dq, dk, dv
 
 
 def flash_attention(
@@ -421,26 +434,57 @@ def flash_attention(
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 
+def _gqa_group(q: jax.Array, k: jax.Array) -> int:
+    """Query heads per key/value head. Dense attention is group 1;
+    grouped-query attention (Hq = g·Hkv) maps q-head h to kv-head
+    h // g — expressed in the kernels purely through BlockSpec index
+    maps, so K/V are never materialized per q-head."""
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq % hkv:
+        raise ValueError(
+            f"query heads ({hq}) must be a multiple of kv heads ({hkv})"
+        )
+    return hq // hkv
+
+
+def _kv_index_map(h: int, group: int, block_axis: int = 2):
+    """Flat q-head grid index -> flat kv-head row: bh = b·H + h_q maps to
+    b·(H//group) + h_q//group. ``block_axis`` selects which grid axis
+    carries the k-block index (2 for the forward/dq grids (bh, q, k),
+    1 for the dk/dv grid (bh, k, q))."""
+    hkv = h // group
+
+    def index_map(bh, a, b_):
+        return (
+            (bh // h) * hkv + (bh % h) // group,
+            a if block_axis == 1 else b_,
+            0,
+        )
+
+    return index_map
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
 def _flash_forward(
-    q: jax.Array,  # [B, H, S, D]
-    k: jax.Array,
+    q: jax.Array,  # [B, Hq, S, D]
+    k: jax.Array,  # [B, Hkv, S, D] — Hq % Hkv == 0 (GQA); dense if equal
     v: jax.Array,
     causal: bool,
     block_q: int,
     block_k: int,
     interpret: bool,  # resolved by flash_attention(); never None here
 ):
-    """Returns (out [B,H,S,D], lse [B,H,S,1] float32)."""
+    """Returns (out [B,Hq,S,D], lse [B,Hq,S,1] float32)."""
     b, h, s, d = q.shape
-    assert k.shape == v.shape == (b, h, s, d)
+    group = _gqa_group(q, k)
+    assert k.shape == v.shape == (b, h // group, s, d)
     block_q, block_k = _resolve_blocks(s, block_q, block_k)
 
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * (h // group), s, d)
+    vf = v.reshape(b * (h // group), s, d)
 
     grid = (b * h, s // block_q, s // block_k)
     kernel = functools.partial(
@@ -450,6 +494,7 @@ def _flash_forward(
         block_q=block_q,
         block_k=block_k,
     )
+    kv_map = _kv_index_map(h, group)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
@@ -459,8 +504,8 @@ def _flash_forward(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),

@@ -47,23 +47,37 @@ def _chunk_attn(q, k, v, scale, mask):
 
     Returns (unnormalized_out [Bq, D] rows scaled by exp(s - m), row max
     m [Bq, 1], row denominator l [Bq, 1]) for the online-softmax merge.
-    q: [B, H, Sq, D]; k, v: [B, H, Sk, D]; mask: [Sq, Sk] bool or None.
+    q: [B, Hq, Sq, D]; k, v: [B, Hkv, Sk, D] with Hq % Hkv == 0 (GQA:
+    q-head h attends kv-head h // group; the grouped einsum never
+    materializes K/V per q-head); mask: [Sq, Sk] bool or None.
     """
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if hq % hkv:
+        raise ValueError(
+            f"query heads ({hq}) must be a multiple of kv heads ({hkv})"
+        )
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, sq, d)
     s = (
-        jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    )  # [B, H, Sq, Sk]
+        jnp.einsum("bhgqd,bhkd->bhgqk", qg, k).astype(jnp.float32) * scale
+    )  # [B, Hkv, G, Sq, Sk]
     if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)  # [B, H, Sq, 1]
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B, Hkv, G, Sq, 1]
     # A fully-masked row (possible only pre-merge) has m == -inf; guard
     # the exp so it contributes zeros, not NaNs.
     m_safe = jnp.maximum(m, _NEG_INF / 2)
     p = jnp.exp(s - m_safe)
     if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask[None, None, None], p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return o, m_safe, l
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return (
+        o.reshape(b, hq, sq, d),
+        m_safe.reshape(b, hq, sq, 1),
+        l.reshape(b, hq, sq, 1),
+    )
 
 
 def _merge(acc, o, m_new, l_new):
