@@ -73,10 +73,21 @@ class TransformerConfig:
     #                    (loss_fn permutes tokens/targets once at the
     #                    input); forward() then expects tokens ALREADY in
     #                    zigzag order and returns logits in that order.
+    #   "ulysses"      — all-to-all sequence parallelism: one all_to_all
+    #                    re-partitions [B,H,S/n,D] -> [B,H/n,S,D], each
+    #                    device runs FULL-sequence (flash) attention on
+    #                    its head subset, and a second all_to_all
+    #                    restores the layout. Needs per-device heads
+    #                    divisible by the sp axis; tokens stay in
+    #                    original order.
     ring_attention: Any = False
-    # Per-chunk attention inside the ring: "einsum" or "flash" (the fused
-    # Pallas kernel via its custom VJP — differentiable, O(chunk·D)
-    # on-device memory). Chunk length must satisfy resolve_flash_block.
+    # Local attention implementation for every sequence-parallel mode:
+    # "einsum" or "flash" (the fused Pallas kernel via its custom VJP —
+    # differentiable, O(rows·D) on-device memory). For ring modes this
+    # is the per-chunk attention and resolve_flash_block applies to the
+    # RING CHUNK length (S / sp, halved again under zigzag); for
+    # "ulysses" it is the full-sequence local attention and the
+    # constraint applies to the GLOBAL sequence length S.
     ring_chunk_impl: str = "einsum"
 
 
@@ -95,14 +106,15 @@ def _n_kv_heads(config: "TransformerConfig") -> int:
 
 
 def _ring_mode(config: "TransformerConfig") -> Optional[str]:
-    """Normalize config.ring_attention to None | "contiguous" | "zigzag"."""
+    """Normalize config.ring_attention to
+    None | "contiguous" | "zigzag" | "ulysses"."""
     r = config.ring_attention
     if r is False or r is None:
         return None
     if r is True or r == "contiguous":
         return "contiguous"
-    if r == "zigzag":
-        return "zigzag"
+    if r in ("zigzag", "ulysses"):
+        return r
     raise ValueError(f"unknown ring_attention mode: {r!r}")
 
 
@@ -282,6 +294,13 @@ def forward(
                 attn = ring_attention_zigzag(
                     qr, kr, vr, mesh, axis="sp", spec=ring_spec,
                     chunk_impl=config.ring_chunk_impl,
+                ).transpose(0, 2, 1, 3)
+            elif ring_mode == "ulysses":
+                from ..parallel.ulysses import ulysses_attention
+
+                attn = ulysses_attention(
+                    qr, kr, vr, mesh, axis="sp", causal=True,
+                    spec=ring_spec, attn_impl=config.ring_chunk_impl,
                 ).transpose(0, 2, 1, 3)
             else:
                 attn = ring_attention(
