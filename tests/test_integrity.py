@@ -217,3 +217,135 @@ def test_object_checksum_set_at_stage_time_only():
     from torchsnapshot_tpu.serialization import decompress_payload, bytes_to_object
 
     assert bytes_to_object(decompress_payload(buf, "zlib")) == {1, 2, 3}
+
+
+def test_snapshot_verify_scrubs_payloads(tmp_path):
+    """Snapshot.verify(): clean snapshot -> {}; corrupted payload ->
+    checksum problem; truncated payload -> size problem; deleted payload
+    -> unreadable. No device involvement."""
+    import os
+
+    state = StateDict(
+        a=jnp.arange(64, dtype=jnp.float32),
+        b=jnp.ones((32,), dtype=jnp.bfloat16),
+        note="hello",
+    )
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": state})
+    assert Snapshot(path).verify() == {}
+
+    # Flip one byte of `a` (content corruption: size unchanged).
+    a_path = os.path.join(path, "0", "s", "a")
+    data = bytearray(open(a_path, "rb").read())
+    data[7] ^= 0xFF
+    open(a_path, "wb").write(bytes(data))
+    problems = Snapshot(path).verify()
+    assert list(problems) == ["0/s/a"]
+    assert "Checksum mismatch" in problems["0/s/a"]
+
+    # Truncate `b` (size mismatch reported before checksum).
+    b_path = os.path.join(path, "0", "s", "b")
+    open(b_path, "wb").write(open(b_path, "rb").read()[:10])
+    problems = Snapshot(path).verify()
+    assert "size mismatch" in problems["0/s/b"]
+
+    # Remove the object entirely.
+    os.remove(a_path)
+    problems = Snapshot(path).verify()
+    assert "unreadable" in problems["0/s/a"]
+
+
+def test_verify_covers_sharded_and_compressed(tmp_path):
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.utils.train_state import PytreeStateful
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    arr = jax.device_put(
+        jax.random.normal(jax.random.key(0), (64, 8)),
+        NamedSharding(mesh, P("x", None)),
+    )
+    path = str(tmp_path / "snap")
+    Snapshot.take(
+        path, {"m": PytreeStateful({"w": arr})}, compression="zlib"
+    )
+    assert Snapshot(path).verify() == {}
+
+
+def test_inspect_cli_verify(tmp_path, capsys):
+    import os
+
+    from torchsnapshot_tpu.inspect import main
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(w=jnp.arange(16, dtype=jnp.float32))})
+    assert main([path, "--verify"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+    w = os.path.join(path, "0", "s", "w")
+    data = bytearray(open(w, "rb").read())
+    data[0] ^= 0xFF
+    open(w, "wb").write(bytes(data))
+    assert main([path, "--verify"]) == 1
+    assert "BAD 0/s/w" in capsys.readouterr().out
+
+
+def test_verify_uses_owner_checksum_for_replicated_stripes(tmp_path):
+    """Replicated payloads appear once per rank in the merged manifest
+    and only the stripe owner's entry carries a checksum. verify() must
+    use the owner's checksum even when a checksum-less copy (another
+    rank's view) appears first (code-review r2: first-wins dedup let
+    corrupted replicated payloads pass as clean)."""
+    import os
+
+    from torchsnapshot_tpu.manifest import ArrayEntry, SnapshotMetadata
+    from torchsnapshot_tpu.serialization import compute_checksum
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+    payload = np.arange(16, dtype=np.float32).tobytes()
+    path = tmp_path / "snap"
+    (path / "replicated" / "s").mkdir(parents=True)
+    (path / "replicated" / "s" / "w").write_bytes(payload)
+
+    def entry(checksum):
+        return ArrayEntry(
+            location="replicated/s/w",
+            serializer="raw",
+            dtype="float32",
+            shape=[16],
+            replicated=True,
+            checksum=checksum,
+        )
+
+    # Rank 0 (non-owner, no checksum) appears BEFORE rank 1 (owner).
+    md = SnapshotMetadata(
+        version="v",
+        world_size=2,
+        manifest={
+            "0/s/w": entry(None),
+            "1/s/w": entry(compute_checksum(payload)),
+        },
+    )
+    (path / SNAPSHOT_METADATA_FNAME).write_text(md.to_yaml())
+
+    assert Snapshot(str(path)).verify() == {}
+
+    corrupted = bytearray(payload)
+    corrupted[5] ^= 0xFF
+    (path / "replicated" / "s" / "w").write_bytes(bytes(corrupted))
+    problems = Snapshot(str(path)).verify()
+    assert "Checksum mismatch" in problems.get("replicated/s/w", "")
+
+
+def test_inspect_verify_delete_mutually_exclusive(tmp_path, capsys):
+    import pytest as _pytest
+
+    from torchsnapshot_tpu.inspect import main
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(w=jnp.arange(4.0))})
+    with _pytest.raises(SystemExit):
+        main([path, "--verify", "--delete"])

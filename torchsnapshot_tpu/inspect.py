@@ -73,7 +73,27 @@ def main(argv=None) -> int:
         help="with --delete: also enumerate the prefix and remove orphans "
         "from interrupted takes (works even without a metadata document)",
     )
+    parser.add_argument(
+        "--verify",
+        action="store_true",
+        help="scrub every payload against its manifest checksum/length; "
+        "exit 1 if any object is bad",
+    )
     args = parser.parse_args(argv)
+
+    if args.verify and (args.delete or args.sweep):
+        parser.error(
+            "--verify cannot be combined with --delete/--sweep; scrub "
+            "first, then delete in a separate invocation"
+        )
+    if args.verify:
+        problems = Snapshot(args.path).verify()
+        if not problems:
+            print("OK: all payloads match their manifest checksums")
+            return 0
+        for location, problem in sorted(problems.items()):
+            print(f"BAD {location}: {problem}")
+        return 1
 
     if args.delete:
         Snapshot(args.path).delete(sweep=args.sweep)
