@@ -113,6 +113,21 @@ def decompress_payload(buf: Any, algo: str) -> bytes:
     return zlib.decompress(buf)
 
 
+class StreamingCrc32:
+    """Incremental crc32 producing the same ``crc32:<hex>`` tag as
+    :func:`compute_checksum` — for verifying large payloads chunk by
+    chunk (bounded memory) instead of reading them whole."""
+
+    def __init__(self) -> None:
+        self._crc = 0
+
+    def update(self, chunk: Any) -> None:
+        self._crc = zlib.crc32(chunk, self._crc)
+
+    def tag(self) -> str:
+        return f"crc32:{self._crc & 0xFFFFFFFF:08x}"
+
+
 def compute_checksum(buf: Any) -> str:
     """crc32 of a payload, tagged with the algorithm for evolvability.
 

@@ -83,6 +83,11 @@ logger = logging.getLogger(__name__)
 
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
+# verify() reads objects larger than this via sequential ranged reads
+# with an incremental crc instead of whole-object reads (bounds scrub
+# memory to chunk x read-concurrency).
+_VERIFY_SCRUB_CHUNK_BYTES = 64 * 1024 * 1024
+
 
 class Snapshot:
     """A handle to a snapshot location.
@@ -520,7 +525,7 @@ class Snapshot:
         replicated stripes) are length-checked only; objects are read
         whole with the backend's read fan-out.
         """
-        from .serialization import verify_checksum
+        from .serialization import StreamingCrc32, array_nbytes, verify_checksum
 
         storage = url_to_storage_plugin(self.path)
         problems: Dict[str, str] = {}
@@ -533,15 +538,8 @@ class Snapshot:
                 if not hasattr(array_entry, "dtype"):
                     return None  # objects: pickled size unknown
                 try:
-                    import math as _math
-
-                    import numpy as _np
-
-                    from .serialization import str_to_dtype
-
-                    return int(
-                        _np.dtype(str_to_dtype(array_entry.dtype)).itemsize
-                        * _math.prod(array_entry.shape)
+                    return array_nbytes(
+                        array_entry.dtype, array_entry.shape
                     )
                 except Exception:
                     return None
@@ -562,11 +560,60 @@ class Snapshot:
                 for loc, (checksum, nbytes) in by_location.items()
             ]
 
+            # Bound host memory: objects with a known size scrub via
+            # sequential ranged reads + incremental crc (dense payloads
+            # are one storage object of unbounded size — only the
+            # sharded write path subdivides at 512 MiB), so peak RAM is
+            # chunk_size x concurrency, not payload x concurrency.
+            scrub_chunk = _VERIFY_SCRUB_CHUNK_BYTES
+
             async def _scrub() -> None:
                 sem = asyncio.Semaphore(max(1, storage.max_read_concurrency))
 
                 async def _one(loc, checksum, nbytes):
                     async with sem:
+                        if nbytes is not None and nbytes > scrub_chunk:
+                            crc = StreamingCrc32()
+                            got = 0
+                            for start in range(0, nbytes, scrub_chunk):
+                                end = min(start + scrub_chunk, nbytes)
+                                io_req = IOReq(
+                                    path=loc, byte_range=(start, end)
+                                )
+                                try:
+                                    await storage.read(io_req)
+                                except Exception as e:
+                                    problems[loc] = f"unreadable: {e!r}"
+                                    return
+                                piece = io_payload(io_req)
+                                got += len(piece)
+                                crc.update(piece)
+                                if len(piece) < end - start:
+                                    break  # truncated object
+                            if got == nbytes:
+                                # Trailing garbage past the manifest size
+                                # is also corruption: probe one byte.
+                                probe = IOReq(
+                                    path=loc, byte_range=(nbytes, nbytes + 1)
+                                )
+                                try:
+                                    await storage.read(probe)
+                                    if len(io_payload(probe)) > 0:
+                                        got = nbytes + 1
+                                except Exception:
+                                    pass  # EOF/unreadable past end: fine
+                            if got != nbytes:
+                                problems[loc] = (
+                                    f"size mismatch: stored {got} bytes "
+                                    f"(or more), manifest implies {nbytes}"
+                                )
+                            elif checksum and crc.tag() != checksum:
+                                problems[loc] = (
+                                    f"Checksum mismatch: stored object is "
+                                    f"corrupt (expected {checksum}, got "
+                                    f"{crc.tag()})."
+                                )
+                            return
                         io_req = IOReq(path=loc)
                         try:
                             await storage.read(io_req)
