@@ -96,14 +96,39 @@ def main() -> None:
         t_einsum = _bench(einsum_fn, q, k, v) if s <= 4096 else float("nan")
 
         def flash_grad(q, k, v):
+            # argnums MUST cover k and v: with argnums=0 the dk/dv
+            # Pallas kernel is dead code under jit and XLA DCEs it —
+            # the "fwd+bwd" number would then time only fwd + dq
+            # (~half the backward FLOPs missing).
             return jax.grad(
                 lambda q, k, v: jnp.sum(
                     flash_fn(q, k, v).astype(jnp.float32) ** 2
                 ),
-                argnums=0,
+                argnums=(0, 1, 2),
             )(q, k, v)
 
-        t_bwd = _bench(flash_grad, q, k, v)
+        @jax.jit
+        def bwd_loop(q, k, v):
+            def body(_, carry):
+                dq, dk, dv = flash_grad(*carry)
+                # All three grads feed the next iteration, so none of
+                # the backward kernels can be dead-code-eliminated.
+                return (
+                    dq.astype(q.dtype),
+                    dk.astype(k.dtype),
+                    dv.astype(v.dtype),
+                )
+
+            out = jax.lax.fori_loop(0, ITERS, body, (q, k, v))
+            return sum(jnp.sum(x.astype(jnp.float32)) for x in out)
+
+        float(bwd_loop(q, k, v))  # compile
+        bwd_times = []
+        for _ in range(3):
+            begin = time.monotonic()
+            float(bwd_loop(q, k, v))
+            bwd_times.append((time.monotonic() - begin) / ITERS)
+        t_bwd = sorted(bwd_times)[1]
 
         causal_flops = 4 * b * h * s * s * d / 2
         tflops = causal_flops / t_flash / 1e12

@@ -394,3 +394,49 @@ def test_verify_streams_large_objects(tmp_path, monkeypatch):
     for i in range(0, len(payload), 100):
         crc.update(payload[i : i + 100])
     assert crc.tag() == compute_checksum(payload)
+
+
+def test_verify_length_only_probes_for_unchecksummed_large_objects(
+    tmp_path, monkeypatch
+):
+    """Large objects without a verifiable crc32 tag get a two-probe
+    length check (last byte + one past the end) instead of a full
+    download whose crc nothing would be compared to; unknown future
+    checksum algorithms are skipped like verify_checksum does."""
+    import os
+
+    import torchsnapshot_tpu.snapshot as snapmod
+    from torchsnapshot_tpu.manifest import ArrayEntry, SnapshotMetadata
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+    monkeypatch.setattr(snapmod, "_VERIFY_SCRUB_CHUNK_BYTES", 64)
+    payload = np.arange(256, dtype=np.float32).tobytes()  # 1 KiB > chunk
+    path = tmp_path / "snap"
+    (path / "0" / "s").mkdir(parents=True)
+    (path / "0" / "s" / "w").write_bytes(payload)
+
+    def meta(checksum):
+        return SnapshotMetadata(
+            version="v",
+            world_size=1,
+            manifest={
+                "0/s/w": ArrayEntry(
+                    location="0/s/w",
+                    serializer="raw",
+                    dtype="float32",
+                    shape=[256],
+                    replicated=False,
+                    checksum=checksum,
+                )
+            },
+        ).to_yaml()
+
+    for tag in (None, "xxh3:abcdef"):  # absent + unknown future algo
+        (path / SNAPSHOT_METADATA_FNAME).write_text(meta(tag))
+        assert Snapshot(str(path)).verify() == {}
+        # Truncated and extended objects still fail the length probes.
+        (path / "0" / "s" / "w").write_bytes(payload[:-4])
+        assert "size mismatch" in Snapshot(str(path)).verify()["0/s/w"]
+        (path / "0" / "s" / "w").write_bytes(payload + b"z")
+        assert "size mismatch" in Snapshot(str(path)).verify()["0/s/w"]
+        (path / "0" / "s" / "w").write_bytes(payload)

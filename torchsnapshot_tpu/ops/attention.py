@@ -59,13 +59,28 @@ def _block_visible(qi, kj, block_q: int, block_k: int):
 
 
 def resolve_flash_block(seq_len: int) -> int:
-    """The MXU tiling policy, shared by every flash call site: largest
-    power-of-two divisor of the sequence length, capped at 128; lengths
-    whose factor is below the sublane minimum (8) are rejected — they
-    would tile into sub-MXU scalar-sized blocks, worse than einsum."""
-    import math
+    """The tiling policy, shared by every flash call site: largest
+    power-of-two divisor of the sequence length, capped at 1024.
 
-    block = math.gcd(seq_len, 128)
+    The cap is a VMEM-residency choice, not an MXU one: bigger tiles
+    amortize the per-block online-softmax bookkeeping and k/v tile
+    revisits. Measured on one v5e chip (S=4096, D=128, bf16, causal):
+    128-wide tiles sustain ~10 TFLOP/s forward, 512 ~50, 1024 ~80 (and
+    ~6× on forward+backward); 2048² tiles exceed VMEM and fail to
+    compile. A 1024² f32 score tile is 4 MB — resident even on 16 MB
+    VMEM generations. Lengths whose power-of-two factor is below the
+    sublane minimum (8) are rejected — they would tile into sub-MXU
+    scalar-sized blocks, worse than einsum.
+
+    The numbers above are v5e; the backward pass holds several
+    [block, block] f32 intermediates live per tile, so a generation
+    with much smaller VMEM may need a smaller cap —
+    ``TPUSNAPSHOT_FLASH_BLOCK_CAP`` overrides it without code changes."""
+    import math
+    import os
+
+    cap = int(os.environ.get("TPUSNAPSHOT_FLASH_BLOCK_CAP", 1024))
+    block = math.gcd(seq_len, cap)
     if block < 8:
         raise ValueError(
             f"flash attention needs a sequence length with a power-of-two "

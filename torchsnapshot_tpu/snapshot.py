@@ -571,7 +571,46 @@ class Snapshot:
                 sem = asyncio.Semaphore(max(1, storage.max_read_concurrency))
 
                 async def _one(loc, checksum, nbytes):
+                    # Only crc32 tags are verifiable here; unknown future
+                    # algorithms are skipped exactly like verify_checksum
+                    # does (forward compatibility), leaving a length check.
+                    crc_checkable = bool(
+                        checksum and checksum.startswith("crc32:")
+                    )
                     async with sem:
+                        if (
+                            nbytes is not None
+                            and nbytes > scrub_chunk
+                            and not crc_checkable
+                        ):
+                            # Length-only verdict for a large object:
+                            # probe the last byte and one past the end
+                            # instead of downloading gigabytes to
+                            # compute a crc nothing will be compared to.
+                            try:
+                                last = IOReq(
+                                    path=loc, byte_range=(nbytes - 1, nbytes)
+                                )
+                                await storage.read(last)
+                                if len(io_payload(last)) != 1:
+                                    problems[loc] = (
+                                        f"size mismatch: shorter than the "
+                                        f"{nbytes} bytes the manifest implies"
+                                    )
+                                    return
+                                past = IOReq(
+                                    path=loc,
+                                    byte_range=(nbytes, nbytes + 1),
+                                )
+                                await storage.read(past)
+                                if len(io_payload(past)) > 0:
+                                    problems[loc] = (
+                                        f"size mismatch: longer than the "
+                                        f"{nbytes} bytes the manifest implies"
+                                    )
+                            except Exception as e:
+                                problems[loc] = f"unreadable: {e!r}"
+                            return
                         if nbytes is not None and nbytes > scrub_chunk:
                             crc = StreamingCrc32()
                             got = 0
@@ -607,7 +646,7 @@ class Snapshot:
                                     f"size mismatch: stored {got} bytes "
                                     f"(or more), manifest implies {nbytes}"
                                 )
-                            elif checksum and crc.tag() != checksum:
+                            elif crc_checkable and crc.tag() != checksum:
                                 problems[loc] = (
                                     f"Checksum mismatch: stored object is "
                                     f"corrupt (expected {checksum}, got "
