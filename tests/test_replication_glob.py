@@ -153,3 +153,122 @@ def test_metadata_world_size(tmp_path):
 
     md = SnapshotMetadata.from_yaml(meta_file.read_text())
     assert md.world_size == 2
+
+
+def test_size_balanced_striping_assignment():
+    """Replicated-write ownership is size-balanced (greedy LPT), not
+    count-round-robin: one huge leaf among many small ones must not give
+    a single rank ~all the bytes (the reference's count-based striping
+    does exactly that — its snapshot.py:353-358)."""
+    from torchsnapshot_tpu.snapshot import _assign_replicated_owners
+
+    # 1 GB + 100 x 1 MB over 4 ranks.
+    sizes = {"big": 1 << 30}
+    sizes.update({f"small{i:03d}": 1 << 20 for i in range(100)})
+    owners = _assign_replicated_owners(sizes, 4)
+    loads = [0, 0, 0, 0]
+    for path, owner in owners.items():
+        loads[owner] += sizes[path]
+    # The big leaf lands alone on one rank; the other three share the
+    # small ones — max load is the big leaf, min is ~33 MB, and crucially
+    # no rank holds big + a meaningful share of smalls.
+    assert max(loads) == 1 << 30
+    assert sum(1 for load in loads if load > 1 << 30) == 0
+    small_total = 100 * (1 << 20)
+    others = sorted(loads)[:3]
+    assert sum(others) == small_total
+    assert max(others) - min(others) <= 2 * (1 << 20)  # near-even split
+
+    # Count-round-robin for comparison: rank of "big" also gets ~25 of
+    # the smalls — the property LPT removes.
+
+    # Determinism: same inputs -> same map (every rank must agree).
+    assert owners == _assign_replicated_owners(sizes, 4)
+
+    # Equal sizes degrade to a balanced count split.
+    eq = {f"p{i}": 100 for i in range(8)}
+    owners_eq = _assign_replicated_owners(eq, 4)
+    counts = [0] * 4
+    for owner in owners_eq.values():
+        counts[owner] += 1
+    assert counts == [2, 2, 2, 2]
+
+    # Zero-estimate paths (objects) spread by COUNT, not byte-load-min:
+    # a single big array must not attract every object to the other
+    # ranks' detriment.
+    mixed = {"big": 10 << 20}
+    mixed.update({f"obj{i}": 0 for i in range(10)})
+    owners_mixed = _assign_replicated_owners(mixed, 2)
+    obj_counts = [0, 0]
+    for p, o in owners_mixed.items():
+        if p != "big":
+            obj_counts[o] += 1
+    assert abs(obj_counts[0] - obj_counts[1]) <= 1, owners_mixed
+
+
+def test_size_balanced_striping_end_to_end(tmp_path):
+    """2-rank take with one big and many small replicated leaves: each
+    rank's written payload bytes reflect size balancing, and the
+    snapshot round-trips."""
+    import threading
+
+    import numpy as np
+
+    from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+
+    def worker(rank, store, errors):
+        try:
+            coord = StoreCoordinator(store, rank, 2, timeout_s=60)
+            sd = {"big": np.zeros(1 << 18, dtype=np.float32)}  # 1 MiB
+            for i in range(16):
+                sd[f"s{i:02d}"] = np.full(1 << 14, i, dtype=np.float32)  # 64 KiB
+            class _Raw:
+                def __init__(self, sd):
+                    self.sd = sd
+
+                def state_dict(self):
+                    return self.sd
+
+                def load_state_dict(self, sd):
+                    self.sd = sd
+
+            Snapshot.take(
+                f"memory://stripe-{rank}",
+                {"st": _Raw(sd)},
+                coord=coord,
+                replicated=["**"],
+            )
+        except BaseException:  # pragma: no cover
+            import traceback
+
+            errors.append(traceback.format_exc())
+
+    store = DictStore()
+    errors = []
+    threads = [
+        threading.Thread(target=worker, args=(r, store, errors))
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[0]
+
+    # Path collation broadcasts rank 0's URL, so both ranks wrote into
+    # one bucket; per-rank bytes are attributed via the manifest — only
+    # the stripe OWNER's entry carries a checksum. Each rank must own
+    # ~half the payload bytes (the big leaf on one side, the 16 smalls
+    # on the other), not big+half-the-smalls vs half-the-smalls as
+    # count-round-robin would give.
+    from torchsnapshot_tpu.serialization import array_nbytes
+
+    manifest = Snapshot("memory://stripe-0").get_manifest()
+    per_rank = {0: 0, 1: 0}
+    for path, entry in manifest.items():
+        owner = int(path.split("/", 1)[0])
+        if getattr(entry, "checksum", None) and hasattr(entry, "dtype"):
+            per_rank[owner] += array_nbytes(entry.dtype, entry.shape)
+    total = (1 << 20) + 16 * (1 << 16)
+    for nbytes in per_rank.values():
+        assert abs(nbytes - total / 2) <= total * 0.05, per_rank

@@ -22,7 +22,8 @@ statefuls in global key order with barriers, RNG state restored last.
 Value categories (reference snapshot.py:79-113):
   - **sharded** — partitioned ``jax.Array``s; always elastic.
   - **replicated** — opt-in via glob patterns on logical paths; writes are
-    striped round-robin across processes (snapshot.py:313-359); elastic.
+    striped across processes, size-balanced (greedy LPT; the reference
+    round-robins by count, snapshot.py:313-359); elastic.
   - **per-rank** — everything else; restore requires the same world size.
 
 Async snapshots (beyond strict parity; BASELINE.json north star):
@@ -906,31 +907,46 @@ def _negotiate_replicated_paths(
     coordinator: Coordinator,
     flattened: Dict[str, Any],
     replicated_globs: List[str],
-) -> List[str]:
-    """Glob-match logical paths; intersect across ranks.
+) -> Dict[str, int]:
+    """Glob-match logical paths; intersect across ranks. Returns
+    ``{path: size_estimate}`` for the negotiated set.
 
     A path is treated as replicated only if *every* rank matched it
     (rank-divergent globs degrade to the intersection — reference
     snapshot.py:313-359, tests/test_replication_glob.py:103-112).
     Partitioned arrays are excluded: the sharded category wins.
 
+    Size estimates ride the same gather and are reconciled as the
+    per-path MAX across ranks: the size-balanced owner assignment must
+    be a pure function of rank-identical inputs, and a locally-computed
+    nbytes could diverge (e.g. a mixed-dtype bug, or an array on one
+    rank and a 0-estimating object on another) — divergent owner maps
+    would leave a path with zero writers or two.
+
     The gather runs whenever world_size > 1 — even with empty globs or an
     absent stateful — so every rank issues the identical collective
     sequence regardless of divergent arguments or key sets.
     """
-    matched = set()
-    for path in flattened.keys():
+    matched: Dict[str, int] = {}
+    for path, value in flattened.items():
         for glob in replicated_globs:
             if fnmatch.fnmatch(path, glob):
-                matched.add(path)
+                matched[path] = _safe_nbytes(value)
                 break
     if coordinator.get_world_size() == 1:
-        return sorted(matched)
-    all_matched = coordinator.all_gather_object(sorted(matched))
-    inter = set(all_matched[0])
+        return matched
+    all_matched = coordinator.all_gather_object(
+        sorted(matched.items())
+    )
+    inter = set(p for p, _ in all_matched[0])
     for m in all_matched[1:]:
-        inter &= set(m)
-    return sorted(inter)
+        inter &= set(p for p, _ in m)
+    sizes: Dict[str, int] = {path: 0 for path in inter}
+    for m in all_matched:
+        for path, size in m:
+            if path in sizes:
+                sizes[path] = max(sizes[path], size)
+    return sizes
 
 
 def _save_stateful(
@@ -952,20 +968,27 @@ def _save_stateful(
         flattened: Dict[str, Any] = {}
     else:
         container_manifest, flattened = flatten(state_dict, prefix=key)
-    replicated_paths = set(
-        _negotiate_replicated_paths(coordinator, flattened, replicated_globs)
+    replicated_sizes = _negotiate_replicated_paths(
+        coordinator, flattened, replicated_globs
     )
+    replicated_paths = set(replicated_sizes)
     world_size = coordinator.get_world_size()
 
     manifest_out.update(container_manifest)
-    # Round-robin ownership stripes replicated writes across processes
-    # (reference snapshot.py:353-358). The stripe index is computed over
-    # the sorted *replicated* path set only — it is rank-identical by
-    # construction (intersection), whereas each rank's full flattened key
-    # list may diverge.
-    replicated_stripe = {
-        path: i for i, path in enumerate(sorted(replicated_paths))
-    }
+    # Stripe replicated writes across processes. The reference assigns
+    # round-robin by COUNT (its snapshot.py:353-358), which skews bytes
+    # badly when leaf sizes differ (one 1 GB embedding next to a hundred
+    # scalars); ownership here is size-balanced instead — greedy
+    # longest-processing-time over rank-stable size estimates — so every
+    # rank writes ~1/N of the replicated BYTES and the take's tail isn't
+    # one unlucky rank. The assignment is computed from the negotiated
+    # (rank-identical) path set and array nbytes (rank-identical for
+    # replicated arrays; non-array sizes estimate as 0 since pickled
+    # bytes may legitimately differ per rank), so every rank derives the
+    # same owner map without another collective.
+    replicated_owner = _assign_replicated_owners(
+        replicated_sizes, world_size
+    )
     for logical_path, value in sorted(flattened.items()):
         replicated = logical_path in replicated_paths
         entry, write_reqs = prepare_write(
@@ -979,7 +1002,7 @@ def _save_stateful(
         if isinstance(entry, ShardedArrayEntry):
             replicated = False
         manifest_out[logical_path] = entry
-        if replicated and replicated_stripe[logical_path] % world_size != rank:
+        if replicated and replicated_owner[logical_path] != rank:
             # Another process owns this replicated write. Its payload bytes
             # (hence checksum) are the owner's — ours may legitimately
             # differ (e.g. pickle insertion order) and must not be
@@ -988,6 +1011,43 @@ def _save_stateful(
                 entry.checksum = None
             continue
         write_reqs_out.extend(write_reqs)
+
+
+def _safe_nbytes(value: Any) -> int:
+    try:
+        return int(getattr(value, "nbytes", 0) or 0)
+    except Exception:
+        return 0
+
+
+def _assign_replicated_owners(
+    sizes: Dict[str, int], world_size: int
+) -> Dict[str, int]:
+    """Deterministic size-balanced owner per replicated path.
+
+    Greedy LPT: paths in (size desc, path) order each go to the
+    least-byte-loaded rank. Pure function of rank-identical inputs (the
+    sizes come reconciled from the negotiation gather), so every process
+    computes the same map with no extra collective. Paths with a zero
+    size estimate (non-arrays — their pickled size is rank-variable and
+    unknowable here) spread by COUNT instead: byte-load-min would pile
+    every one of them onto whichever rank happens to hold the fewest
+    bytes, recreating the skew this assignment exists to remove."""
+    if world_size <= 1:
+        return {path: 0 for path in sizes}
+    byte_loads = [0] * world_size
+    count_loads = [0] * world_size
+    owners: Dict[str, int] = {}
+    for path in sorted(sizes, key=lambda p: (-sizes[p], p)):
+        size = sizes[path]
+        if size > 0:
+            owner = min(range(world_size), key=lambda r: byte_loads[r])
+            byte_loads[owner] += size
+        else:
+            owner = min(range(world_size), key=lambda r: count_loads[r])
+        owners[path] = owner
+        count_loads[owner] += 1
+    return owners
 
 
 _COMPLETION_TIMEOUT_S = 1800.0
