@@ -31,9 +31,17 @@ Env knobs:
                                    calibrated to ~45 s of take per run,
                                    clamped to [64 MiB, 2 GiB])
   TPUSNAPSHOT_BENCH_RESTORE_BYTES  bytes restored in the restore timing
-                                   (default: bench_bytes / 4 — restore
-                                   is gated by sustained H2D, the slower
-                                   direction of the tunnel)
+                                   (default: bench_bytes / 4, shrunk to
+                                   <=100 MiB when the take budget below
+                                   was exhausted — restore is gated by
+                                   sustained H2D, the slower direction
+                                   of the tunnel)
+  TPUSNAPSHOT_BENCH_TAKE_BUDGET_S  soft cumulative budget for the timed
+                                   take runs (default 200 s): when
+                                   tenancy degrades after calibration,
+                                   remaining runs are skipped and the
+                                   async/restore payloads shrink so an
+                                   external timeout is not blown
   TPUSNAPSHOT_BENCH_DIR            target directory (default: fresh tmpdir)
 """
 
@@ -174,6 +182,14 @@ def main() -> None:
         times = []
         ratios = []
         probes = [d2h_gbps]
+        # Calibration samples tenancy ONCE; if the link collapses
+        # mid-measurement (observed: 2.5x inside two minutes), three
+        # full runs + restore can blow any external timeout. Stop taking
+        # new runs once the cumulative take time passes the soft budget
+        # — a 1- or 2-run median is better than a dead benchmark.
+        take_budget_s = float(
+            os.environ.get("TPUSNAPSHOT_BENCH_TAKE_BUDGET_S", 200)
+        )
         for i in range(3):
             shutil.rmtree(f"{bench_dir}/snap", ignore_errors=True)
             try:
@@ -193,8 +209,21 @@ def main() -> None:
                 f"-> ratio {ratios[-1]:.2f})",
                 file=sys.stderr,
             )
-        elapsed = sorted(times)[1]
-        take_vs_ceiling = sorted(ratios)[1]
+            if sum(times) > take_budget_s:
+                print(
+                    f"[bench] take budget exhausted "
+                    f"({sum(times):.0f}s > {take_budget_s:.0f}s): "
+                    f"tenancy degraded after calibration; using "
+                    f"{len(times)} run(s) and shrinking the async/restore "
+                    f"payloads",
+                    file=sys.stderr,
+                )
+                break
+        # (len-1)//2: with an even count after an early budget break,
+        # //2 would select the SLOWER (collapsed-tenancy) run — the
+        # opposite of what the truncation is for.
+        elapsed = sorted(times)[(len(times) - 1) // 2]
+        take_vs_ceiling = sorted(ratios)[(len(ratios) - 1) // 2]
         d2h_gbps = max(probes)
 
         gbps = nbytes / (1024**3) / elapsed
@@ -205,8 +234,22 @@ def main() -> None:
         # subsequent device op (the consistent-cut clone) would wait on
         # that queue — training code would never take a snapshot mid-
         # restore, so that wait is not part of the stall.
+        over_budget = sum(times) > take_budget_s
+        if over_budget:
+            # The async drain moves the full payload over the same
+            # degraded link; measure the stall on a one-parameter app
+            # state instead so the drain cannot blow the external
+            # timeout (the stall is per-take structure — clone dispatch
+            # + one completion wait — not payload-proportional).
+            async_state = {
+                "model": SyntheticModel(
+                    n_params=1, param_bytes=param_bytes, seed=3
+                )
+            }
+        else:
+            async_state = app_state
         async_begin = time.monotonic()
-        pending = Snapshot.async_take(f"{bench_dir}/snap-async", app_state)
+        pending = Snapshot.async_take(f"{bench_dir}/snap-async", async_state)
         async_stall = time.monotonic() - async_begin
         print(f"[bench] async stall: {async_stall:.3f}s", file=sys.stderr)
         pending.wait()
@@ -229,7 +272,14 @@ def main() -> None:
         # landed in HBM (block_until_ready alone is not sufficient here).
         restore_bytes = int(
             os.environ.get(
-                "TPUSNAPSHOT_BENCH_RESTORE_BYTES", total_bytes // 4
+                "TPUSNAPSHOT_BENCH_RESTORE_BYTES",
+                # Shrink the restore payload when the takes already ran
+                # long (degraded tenancy): H2D is the slower direction
+                # and a full-size restore would double down on the
+                # overrun.
+                total_bytes // 4
+                if not over_budget
+                else min(total_bytes // 4, 100 * 1024 * 1024),
             )
         )
         n_restore = max(
