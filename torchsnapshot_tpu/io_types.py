@@ -70,6 +70,43 @@ def is_not_found_error(exc: BaseException) -> bool:
     return False
 
 
+def is_range_not_satisfiable_error(exc: BaseException) -> bool:
+    """Whether a storage failure means "requested byte range starts at or
+    past the end of the object".
+
+    GCS raises 416 RequestRangeNotSatisfiable and S3 raises InvalidRange
+    (HTTP 416) when a ranged GET's start offset is >= the object length.
+    ``verify()`` probes one byte past the expected end of large objects to
+    detect trailing garbage — on these backends a *healthy* object answers
+    that probe with 416, so the probe must classify it as "object ends
+    exactly where the manifest implies", not as corruption. Like
+    not-found, 416 is deterministic: the retry layer must not retry it.
+    Classification is structural (exception type + status-code
+    attributes), never by message substring — same rationale as
+    :func:`is_not_found_error`.
+    """
+    for klass in type(exc).__mro__:
+        if klass.__name__ in (
+            "RequestRangeNotSatisfiable",  # google.api_core.exceptions
+            "RequestedRangeNotSatisfiable",  # werkzeug/HTTP libs spelling
+            "InvalidRange",
+        ):
+            return True
+    code = getattr(exc, "code", None)
+    try:
+        if code is not None and int(code) == 416:
+            return True
+    except (TypeError, ValueError):
+        pass
+    response = getattr(exc, "response", None)
+    if isinstance(response, dict):
+        if response.get("Error", {}).get("Code") in ("416", "InvalidRange"):
+            return True
+        if response.get("ResponseMetadata", {}).get("HTTPStatusCode") == 416:
+            return True
+    return False
+
+
 # Storage-op retry policy (beyond reference parity: the reference has no
 # retries anywhere — one transient object-store 5xx aborts the whole
 # snapshot, SURVEY §5). Writes are whole-object puts, reads are (ranged)
@@ -102,7 +139,11 @@ async def retry_storage_op(make_coro, desc: str):
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            if is_not_found_error(e) or attempt == attempts:
+            if (
+                is_not_found_error(e)
+                or is_range_not_satisfiable_error(e)
+                or attempt == attempts
+            ):
                 raise
             logger.warning(
                 f"Storage op {desc} failed (attempt {attempt}/{attempts}): "

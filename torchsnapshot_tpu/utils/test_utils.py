@@ -109,3 +109,54 @@ def run_multiprocess(
             failures.append((p.pid, f"exitcode={p.exitcode}"))
     if failures:
         raise RuntimeError(f"Worker failures: {failures}")
+
+
+def run_thread_ranks(
+    world: int,
+    fn: Callable,
+    store: Optional[Any] = None,
+    timeout_s: float = 120.0,
+) -> List[Any]:
+    """Run ``fn(coordinator, rank)`` on ``world`` threads coordinating
+    over one shared store (``DictStore`` by default); returns per-rank
+    results. The in-process analog of :func:`run_multiprocess` — cheap
+    enough for world sizes like 64 that real processes cannot reach in a
+    test. Any rank's failure (with its traceback) fails the call."""
+    import threading
+
+    from ..coord import DictStore, StoreCoordinator
+
+    store = store if store is not None else DictStore()
+    results: List[Any] = [None] * world
+    errors: List[Any] = []
+
+    def worker(rank: int) -> None:
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=timeout_s)
+            results[rank] = fn(coord, rank)
+        except BaseException:  # pragma: no cover - surfaced via raise below
+            errors.append((rank, traceback.format_exc()))
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(world)
+    ]
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.start()
+    for t in threads:
+        # One SHARED deadline: sequential full-timeout joins would wait
+        # world x timeout_s before reporting a genuine deadlock.
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed:\n{errors[0][1]}")
+    hung = [r for r, t in enumerate(threads) if t.is_alive()]
+    if hung:
+        # Without this, a deadlocked rank silently yields None results and
+        # the non-daemon thread pins the process until its own (much
+        # longer) internal poll deadlines expire.
+        raise AssertionError(
+            f"rank(s) {hung} still running after {timeout_s}s join timeout"
+        )
+    return results
