@@ -114,8 +114,8 @@ def test_collective_keys_are_garbage_collected_dictstore():
 
     _run_ranks_on_store(store, world, fn)
     # Each rank retains at most its final-generation barrier key (a
-    # straggler may still need to read it); 4,000 barriers x 4 ranks
-    # wrote 4,000 keys total.
+    # straggler may still need to read it). Without GC this run would
+    # leave 1,000 generations x 4 ranks = 4,000 keys.
     assert store.key_count() <= 2 * world
 
 

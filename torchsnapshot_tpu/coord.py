@@ -124,7 +124,10 @@ class FileStore(Store):
     def delete(self, key: str) -> None:
         try:
             os.unlink(self._file(key))
-        except FileNotFoundError:
+        except OSError:
+            # Best-effort (Store.delete contract): a stale-handle/perms
+            # hiccup on a shared filesystem must never fail the snapshot
+            # whose collective triggered the GC.
             pass
 
     def key_count(self) -> int:
