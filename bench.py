@@ -109,18 +109,23 @@ def _restore_floor_bytes() -> int:
 
 
 def _probe_h2d_gbps() -> float:
-    """Measure the current H2D ceiling: device_put of a 64 MB host array,
-    synced by a forced device reduction (device_put returns before bytes
-    cross the link on this platform). Best of two; the first also warms
-    the reduction's compile."""
+    """Measure the current H2D ceiling with the chunked-put transfer the
+    restore path itself uses (measured on this platform: chunked sustains
+    ~1.4x a single large device_put, so a plain-put probe would understate
+    the ceiling), synced by a forced device reduction (device_put returns
+    before bytes cross the link here). Best of two; the first also warms
+    the reduction's and concatenate's compiles."""
     import numpy as np
 
+    from torchsnapshot_tpu.ops.transfer import chunked_device_put
+
     host = np.ones((16 * 1024 * 1024,), dtype=np.float32)
+    device = jax.devices()[0]
     force = jax.jit(jnp.sum)
     best = 0.0
     for _ in range(2):
         begin = time.monotonic()
-        arr = jax.device_put(host)
+        arr = chunked_device_put(host, device)
         float(force(arr))
         elapsed = time.monotonic() - begin
         best = max(best, host.nbytes / 1024**3 / elapsed)

@@ -22,15 +22,30 @@ from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
 # ------------------------------------------------------------------ fakes
 
 
+class _FakeNotFound(Exception):
+    """Shaped like google.api_core.exceptions.NotFound (code=404). The
+    real client never raises KeyError — fakes must speak the same
+    dialect the structural not-found classifier understands."""
+
+    code = 404
+
+
 class _FakeBlob:
-    def __init__(self, store, key):
+    def __init__(self, store, key, hooks=None):
         self._store = store
         self._key = key
+        self._hooks = hooks or {}
+        self.size = None
 
     def upload_from_file(self, fileobj):
+        on_upload = self._hooks.get("on_upload")
+        if on_upload is not None:
+            on_upload(self._key)
         self._store[self._key] = fileobj.read()
 
     def download_as_bytes(self, start=None, end=None):
+        if self._key not in self._store:
+            raise _FakeNotFound(f"404 GET {self._key}")
         data = self._store[self._key]
         if start is None:
             return data
@@ -40,28 +55,40 @@ class _FakeBlob:
     def compose(self, sources):
         # Server-side concatenation, as google.cloud.storage.Blob.compose.
         assert len(sources) <= 32, "GCS compose caps at 32 components"
+        on_compose = self._hooks.get("on_compose")
+        if on_compose is not None:
+            on_compose(self._key)
         self._store[self._key] = b"".join(
             self._store[s._key] for s in sources
         )
 
+    def reload(self):
+        self.size = len(self._store[self._key])
+
     def delete(self):
+        if self._key not in self._store:
+            raise _FakeNotFound(f"404 DELETE {self._key}")
         del self._store[self._key]
 
 
 class _FakeGCSBucket:
-    def __init__(self, store):
+    def __init__(self, store, hooks=None):
         self._store = store
+        self._hooks = hooks
 
     def blob(self, key):
-        return _FakeBlob(self._store, key)
+        return _FakeBlob(self._store, key, self._hooks)
 
 
 class _FakeGCSClient:
     def __init__(self):
         self.store = {}
+        # Test-injected failure hooks: callables invoked with the object
+        # key before the corresponding fake operation runs.
+        self.hooks = {}
 
     def bucket(self, name):
-        return _FakeGCSBucket(self.store)
+        return _FakeGCSBucket(self.store, self.hooks)
 
     def list_blobs(self, bucket_name, prefix=""):
         from types import SimpleNamespace
@@ -287,3 +314,137 @@ def test_s3_list_prefix():
     got = asyncio.run(plugin.list_prefix("a/"))
     assert sorted(got) == ["a/b", "a/c"]
     plugin.close()
+
+
+# --------------------------------------------- composite-upload faults
+
+
+def test_gcs_part_upload_failure_cleans_parts_and_surfaces(monkeypatch):
+    """One part failing mid-composite: the error must surface (not a
+    silently truncated object), every already-uploaded part must be
+    cleaned, and nothing must land at the destination key."""
+    monkeypatch.setenv("TPUSNAPSHOT_GCS_PARALLEL_UPLOAD_BYTES", str(1 << 10))
+    client = _FakeGCSClient()
+
+    def fail_part3(key):
+        if ".part3." in key:
+            raise RuntimeError("injected: part 3 upload failed")
+
+    client.hooks["on_upload"] = fail_part3
+    plugin = GCSStoragePlugin("bucket/p", client=client)
+    payload = bytes(range(256)) * 32  # 8 KiB -> 8 parts
+    with pytest.raises(RuntimeError, match="part 3"):
+        asyncio.run(plugin.write(IOReq(path="big", data=payload)))
+    assert [k for k in client.store if ".part" in k] == []
+    assert "p/big" not in client.store
+    plugin.close()
+
+
+def test_gcs_compose_failure_cleans_parts_and_surfaces(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_GCS_PARALLEL_UPLOAD_BYTES", str(1 << 10))
+    client = _FakeGCSClient()
+
+    def fail_compose(key):
+        raise RuntimeError("injected: compose failed")
+
+    client.hooks["on_compose"] = fail_compose
+    plugin = GCSStoragePlugin("bucket/p", client=client)
+    payload = b"x" * (4 << 10)
+    with pytest.raises(RuntimeError, match="compose failed"):
+        asyncio.run(plugin.write(IOReq(path="big", data=payload)))
+    assert [k for k in client.store if ".part" in k] == []
+    assert "p/big" not in client.store
+    plugin.close()
+
+
+def test_gcs_transient_part_failure_retried_whole_object(monkeypatch):
+    """The retry layer re-runs the WHOLE composite write after a
+    transient part failure; the second attempt succeeds byte-exact and
+    leaves no part objects."""
+    from torchsnapshot_tpu.io_types import RetryingStoragePlugin
+
+    monkeypatch.setenv("TPUSNAPSHOT_GCS_PARALLEL_UPLOAD_BYTES", str(1 << 10))
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "2")
+    client = _FakeGCSClient()
+    fails = {"n": 0}
+
+    def fail_once(key):
+        if ".part1." in key and fails["n"] == 0:
+            fails["n"] += 1
+            raise ConnectionError("injected: transient 503")
+
+    client.hooks["on_upload"] = fail_once
+    plugin = RetryingStoragePlugin(
+        GCSStoragePlugin("bucket/p", client=client)
+    )
+    payload = bytes(range(256)) * 16  # 4 KiB -> 4 parts
+    asyncio.run(plugin.write(IOReq(path="big", data=payload)))
+    assert client.store["p/big"] == payload
+    assert [k for k in client.store if ".part" in k] == []
+    assert fails["n"] == 1
+    plugin.close()
+
+
+def test_gcs_composed_size_mismatch_detected(monkeypatch):
+    """A composed object whose size disagrees with the payload (lost
+    part, interfering concurrent upload) is detected by the post-compose
+    size cross-check instead of surfacing at restore time."""
+    monkeypatch.setenv("TPUSNAPSHOT_GCS_PARALLEL_UPLOAD_BYTES", str(1 << 10))
+    client = _FakeGCSClient()
+    store = client.store
+
+    def corrupt_compose(key):
+        # Simulate an interfering writer truncating one part between its
+        # upload and the compose call.
+        for k in list(store):
+            if ".part2." in k:
+                store[k] = store[k][:-7]
+
+    client.hooks["on_compose"] = corrupt_compose
+    plugin = GCSStoragePlugin("bucket/p", client=client)
+    payload = b"y" * (4 << 10)
+    with pytest.raises(RuntimeError, match="composed object is"):
+        asyncio.run(plugin.write(IOReq(path="big", data=payload)))
+    assert [k for k in client.store if ".part" in k] == []
+    plugin.close()
+
+
+def test_gcs_crashed_upload_parts_removed_by_sweep(monkeypatch):
+    """Parts orphaned by a crashed process (no finally cleanup ran) are
+    provably removed by Snapshot.delete(sweep=True)."""
+    import jax.numpy as jnp
+
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    client = _FakeGCSClient()
+    monkeypatch.setattr(
+        sp,
+        "url_to_storage_plugin",
+        lambda url: GCSStoragePlugin(root="bucket/snap", client=client),
+    )
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        sp.url_to_storage_plugin,
+    )
+    from torchsnapshot_tpu import Snapshot
+
+    class _Holder:
+        def __init__(self, sd):
+            self.sd = sd
+
+        def state_dict(self):
+            return self.sd
+
+        def load_state_dict(self, sd):
+            self.sd = sd
+
+    Snapshot.take(
+        "gs://bucket/snap", {"m": _Holder({"w": jnp.arange(64.0)})}
+    )
+    # A concurrent take to the same path crashed mid-composite: nonce-
+    # named part objects remain under the prefix.
+    client.store["snap/sharded/chunk.part0.deadbeef0123"] = b"orphan"
+    client.store["snap/sharded/chunk.part1.deadbeef0123"] = b"orphan"
+    snap = Snapshot("gs://bucket/snap")
+    snap.delete(sweep=True)
+    assert client.store == {}

@@ -275,7 +275,7 @@ def test_metadata_doc_compression_round_trip(tmp_path, monkeypatch):
 
     # End-to-end with the threshold forced low: the stored metadata (and
     # async markers) are compressed on disk, everything still works.
-    monkeypatch.setattr(snapmod, "_METADATA_COMPRESS_THRESHOLD", 64)
+    monkeypatch.setenv("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD", "64")
     state = StateDict(w=jnp.arange(128, dtype=jnp.float32))
     path = str(tmp_path / "snap")
     Snapshot.async_take(path, {"s": state}).wait()
@@ -288,7 +288,9 @@ def test_metadata_doc_compression_round_trip(tmp_path, monkeypatch):
 
     # Uncompressed legacy documents still read (plain take below the
     # restored threshold).
-    monkeypatch.setattr(snapmod, "_METADATA_COMPRESS_THRESHOLD", 1 << 20)
+    monkeypatch.setenv(
+        "TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD", str(1 << 20)
+    )
     path2 = str(tmp_path / "snap2")
     Snapshot.take(path2, {"s": state})
     raw2 = (tmp_path / "snap2" / SNAPSHOT_METADATA_FNAME).read_bytes()

@@ -5,6 +5,7 @@ SURVEY §5)."""
 import asyncio
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -247,10 +248,13 @@ def test_delete_is_idempotent_and_cleans_async_markers(tmp_path):
         Snapshot(path).delete()  # metadata already gone
 
 
-def test_delete_sweep_removes_orphans(tmp_path):
+def test_delete_sweep_removes_orphans(tmp_path, monkeypatch):
     """delete(sweep=True) enumerates the prefix and removes objects the
     manifest does not reference — leftovers of interrupted/superseded
     takes at the same path (ADVICE r1: plain delete leaked them)."""
+    # The freshly-created orphans below would be spared by the
+    # concurrent-take age guard; this test is about enumeration.
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
     path = str(tmp_path / "snap")
     state = StateDict(a=jnp.arange(8, dtype=jnp.float32))
     Snapshot.take(path, {"s": state})
@@ -324,3 +328,51 @@ def test_inspect_cli_delete(tmp_path, capsys):
     assert main([path, "--delete"]) == 0
     assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
     assert "deleted" in capsys.readouterr().out
+
+
+def test_delete_sweep_spares_fresh_unreferenced_objects(tmp_path, monkeypatch):
+    """The concurrent-take guard (ADVICE r2): unreferenced objects
+    younger than TPUSNAPSHOT_SWEEP_MIN_AGE_S look like an in-progress
+    take's uncommitted writes and are spared; old ones are swept."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "3600")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(a=jnp.arange(4, dtype=jnp.float32))})
+    fresh = os.path.join(path, "3", "inflight_chunk")
+    old = os.path.join(path, "3", "stale_chunk")
+    os.makedirs(os.path.dirname(fresh), exist_ok=True)
+    for p in (fresh, old):
+        with open(p, "wb") as f:
+            f.write(b"\x00" * 16)
+    two_hours_ago = time.time() - 7200
+    os.utime(old, (two_hours_ago, two_hours_ago))
+
+    Snapshot(path).delete(sweep=True)
+    leftovers = [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ]
+    assert leftovers == [fresh]  # in-progress-looking object survives
+    # A later sweep (when it has aged out) removes it.
+    os.utime(fresh, (two_hours_ago, two_hours_ago))
+    Snapshot(path).delete(sweep=True)
+    assert [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ] == []
+
+
+def test_delete_sweep_tolerates_corrupt_metadata(tmp_path, monkeypatch):
+    """An interrupted/corrupt metadata document must not make the
+    snapshot undeletable: sweep proceeds (ADVICE r2 — previously only
+    NOT-FOUND metadata was sweepable); plain delete still raises."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(a=jnp.arange(4, dtype=jnp.float32))})
+    with open(os.path.join(path, ".snapshot_metadata"), "wb") as f:
+        f.write(b"\x78\x01 torn zlib garbage")
+
+    with pytest.raises(Exception):
+        Snapshot(path).delete()  # non-sweep: surface the corruption
+
+    Snapshot(path).delete(sweep=True)
+    assert [
+        os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+    ] == []

@@ -94,3 +94,21 @@ def test_url_dispatch(tmp_path):
         assert isinstance(plugin._inner, backend_cls)
     with pytest.raises(RuntimeError, match="Unsupported protocol"):
         url_to_storage_plugin("bogus://x")
+
+
+def test_memory_object_age_visible_across_instances():
+    """mtimes ride the SHARED store, not the plugin instance: sweep
+    resolves a fresh plugin for the same bucket and its age guard must
+    see the ages of objects other instances wrote (code-review r3)."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import IOReq
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    shared = {}
+    writer = MemoryStoragePlugin(shared)
+    asyncio.run(writer.write(IOReq(path="x", data=b"123")))
+    reader = MemoryStoragePlugin(shared)
+    age = asyncio.run(reader.object_age_s("x"))
+    assert age is not None and age < 60.0
+    assert asyncio.run(reader.object_age_s("missing")) is None

@@ -236,6 +236,13 @@ class StoragePlugin(abc.ABC):
         referenced-objects-only deletion."""
         return None
 
+    async def object_age_s(self, path: str) -> Optional[float]:
+        """Seconds since ``path`` was last written, or None when the
+        backend cannot tell. Sweep-style GC uses this to spare objects a
+        concurrent in-progress take wrote moments ago; None means the
+        object is swept unconditionally (pre-age-guard behavior)."""
+        return None
+
     @abc.abstractmethod
     def close(self) -> None:
         ...
@@ -281,6 +288,14 @@ class RetryingStoragePlugin(StoragePlugin):
         return await retry_storage_op(
             lambda: self._inner.list_prefix(prefix), f"list({prefix})"
         )
+
+    async def object_age_s(self, path: str) -> Optional[float]:
+        # No retry: age is advisory (None = unknown) and callers treat
+        # failures the same way.
+        try:
+            return await self._inner.object_age_s(path)
+        except Exception:
+            return None
 
     def close(self) -> None:
         self._inner.close()

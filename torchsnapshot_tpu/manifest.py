@@ -303,6 +303,68 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
     raise ValueError(f"Unknown entry type: {typ}")
 
 
+def _check_fast_path_schema() -> None:
+    """Import-time guard for the __new__-based fast constructors above:
+    they hardcode field lists, so adding a field to ArrayEntry / Shard /
+    ShardedArrayEntry would otherwise silently produce entries missing
+    that attribute, desyncing (de)serialization from the schema
+    (ADVICE r2). Runs once; a mismatch fails loudly at import."""
+    import dataclasses
+
+    probes = {
+        ArrayEntry: _array_entry_from_dict(
+            {
+                "location": "x",
+                "serializer": "raw",
+                "dtype": "float32",
+                "shape": [1],
+                "replicated": False,
+            }
+        ),
+        Shard: entry_from_dict(
+            {
+                "type": "ShardedArray",
+                "dtype": "float32",
+                "shape": [1],
+                "shards": [
+                    {
+                        "offsets": [0],
+                        "sizes": [1],
+                        "array": {
+                            "location": "x",
+                            "serializer": "raw",
+                            "dtype": "float32",
+                            "shape": [1],
+                            "replicated": False,
+                        },
+                    }
+                ],
+            }
+        ).shards[0],
+    }
+    probes[ShardedArrayEntry] = entry_from_dict(
+        {
+            "type": "ShardedArray",
+            "dtype": "float32",
+            "shape": [1],
+            "shards": [],
+        }
+    )
+    for cls, instance in probes.items():
+        expected = {f.name for f in dataclasses.fields(cls)}
+        actual = set(instance.__dict__)
+        if actual != expected:
+            raise AssertionError(
+                f"manifest fast-path constructor for {cls.__name__} is out "
+                f"of sync with its dataclass fields: constructor sets "
+                f"{sorted(actual)}, schema declares {sorted(expected)}. "
+                f"Update entry_from_dict/_array_entry_from_dict."
+            )
+
+
+_check_fast_path_schema()
+
+
 @dataclass
 class SnapshotMetadata:
     version: str

@@ -42,6 +42,7 @@ goes through storage completion markers, never the coordinator.
 import asyncio
 import fnmatch
 import logging
+import os
 import threading
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -463,21 +464,33 @@ class Snapshot:
         ``sweep=True`` additionally enumerates the snapshot prefix and
         removes objects the manifest does NOT reference — orphans from
         interrupted or superseded takes at the same path (uncommitted
-        payload chunks, ``.completed/*`` markers under other nonces).
-        With sweep the metadata document may be absent (an uncommitted
-        take is sweepable); without it, orphans are left behind and
-        require a later ``delete(sweep=True)`` or manual cleanup.
-        Backends that cannot enumerate (``list_prefix`` → None) log a
-        warning and fall back to referenced-only deletion.
+        payload chunks, ``.completed/*`` markers under other nonces,
+        crashed GCS ``.part`` uploads). With sweep the metadata document
+        may be absent or unparseable (an uncommitted or corrupt take is
+        sweepable); without sweep, either still raises. Backends that
+        cannot enumerate (``list_prefix`` → None) log a warning and fall
+        back to referenced-only deletion.
+
+        Concurrent-take guard: unreferenced objects younger than
+        ``TPUSNAPSHOT_SWEEP_MIN_AGE_S`` (default 3600) are spared — an
+        in-progress take to the same path writes payloads, markers, and
+        part uploads that a sweep must not destroy mid-flight. Backends
+        that cannot report object age sweep unconditionally (set the env
+        var to 0 to force that everywhere, e.g. in tests).
         """
         storage = url_to_storage_plugin(self.path)
         try:
             try:
                 metadata = self._read_snapshot_metadata(storage)
             except Exception as e:
-                if not (sweep and is_not_found_error(e)):
+                if not sweep:
                     raise
-                metadata = None  # uncommitted take: sweep-only delete
+                if not is_not_found_error(e):
+                    logger.warning(
+                        f"Snapshot metadata at {self.path} is unreadable "
+                        f"({e!r}); proceeding with sweep-only delete."
+                    )
+                metadata = None  # uncommitted/corrupt take: sweep-only
             locations: Set[str] = set()
             markers: List[str] = []
             if metadata is not None:
@@ -512,9 +525,36 @@ class Snapshot:
                             f"from interrupted takes may remain."
                         )
                         return
+                    min_age_s = float(
+                        os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600)
+                    )
+                    known = locations | set(markers)
+
+                    async def _sweep_one(path: str) -> None:
+                        # Objects this snapshot references are being
+                        # deleted regardless; the age guard protects only
+                        # UNREFERENCED objects, which may belong to a
+                        # concurrent in-progress take. The age probe runs
+                        # INSIDE the semaphore: on cloud backends each
+                        # probe is a HEAD request (the S3 aio path opens a
+                        # client per call) and thousands of orphans must
+                        # not fan out unbounded.
+                        async with sem:
+                            if path not in known and min_age_s > 0:
+                                age = await storage.object_age_s(path)
+                                if age is not None and age < min_age_s:
+                                    logger.info(
+                                        f"sweep: sparing {path} "
+                                        f"(age {age:.0f}s < "
+                                        f"{min_age_s:.0f}s — possibly an "
+                                        f"in-progress take)"
+                                    )
+                                    return
+                            await _delete_ignore_missing(storage, path)
+
                     await asyncio.gather(
                         *(
-                            _one(path)
+                            _sweep_one(path)
                             for path in leftovers
                             if path != SNAPSHOT_METADATA_FNAME
                         )
@@ -1135,14 +1175,30 @@ def _iter_payload_entries(manifest: Manifest):
 # stream begins 0x78, while our documents begin '{' (JSON subset) or a
 # letter (legacy YAML keys: manifest/take_id/version/world_size), so the
 # formats cannot collide and old uncompressed snapshots keep reading.
-_METADATA_COMPRESS_THRESHOLD = 1 << 20
+#
+# Version-compat contract (ADVICE r2): compression is FORWARD-compatible
+# only — snapshots written by this version read fine on this version and
+# newer, but a PRE-compression reader polling a >=1 MiB compressed
+# metadata document treats the binary doc as "not committed yet" and
+# waits out its poll timeout instead of erroring. Mixed-version restore
+# (new writer, old reader) is explicitly out of scope for large
+# manifests; set TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD high to disable
+# compression for one release when doing a rolling upgrade that needs
+# old readers to consume new snapshots.
+def _metadata_compress_threshold() -> int:
+    # Read per-call (like the sibling commit-route knob): the documented
+    # rolling-upgrade workflow sets the env var from training-script
+    # setup code, which may run after this module imports.
+    return int(
+        os.environ.get("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD", 1 << 20)
+    )
 
 
 def _encode_metadata_doc(doc: str) -> bytes:
     import zlib
 
     raw = doc.encode("utf-8")
-    if len(raw) >= _METADATA_COMPRESS_THRESHOLD:
+    if len(raw) >= _metadata_compress_threshold():
         return zlib.compress(raw, 1)
     return raw
 
@@ -1501,8 +1557,6 @@ _DEFAULT_COMMIT_VIA_STORAGE_BYTES = 1 << 20
 
 
 def _commit_via_storage_threshold() -> int:
-    import os
-
     raw = os.environ.get(_COMMIT_VIA_STORAGE_ENV_VAR)
     if raw is None:
         return _DEFAULT_COMMIT_VIA_STORAGE_BYTES

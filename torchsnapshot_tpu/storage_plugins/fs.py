@@ -89,5 +89,14 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self._list_sync, prefix)
 
+    async def object_age_s(self, path: str) -> Optional[float]:
+        import time
+
+        try:
+            st = os.stat(os.path.join(self.root, path))
+        except OSError:
+            return None
+        return max(0.0, time.time() - st.st_mtime)
+
     def close(self) -> None:
         pass

@@ -105,12 +105,32 @@ class GCSStoragePlugin(StoragePlugin):
                     for i in range(n_parts)
                 )
             )
-            await loop.run_in_executor(
-                self._executor,
-                lambda: self._blob(path).compose(
-                    [self._bucket.blob(k) for k in part_keys]
-                ),
-            )
+
+            def _compose_and_check() -> None:
+                blob = self._blob(path)
+                blob.compose([self._bucket.blob(k) for k in part_keys])
+                # Cheap integrity cross-check (one metadata op, no
+                # download): the composed object's size must equal the
+                # payload's. Guards against a part silently truncated or
+                # composed out of an interfering concurrent upload; a
+                # mismatch surfaces here — inside the retry layer, which
+                # re-runs the whole object — instead of at restore time.
+                try:
+                    blob.reload()
+                    composed_size = blob.size
+                except (AttributeError, NotImplementedError):
+                    return  # fakes/backends without metadata reload
+                # Transient reload errors deliberately propagate: a
+                # swallowed 503 here would skip the integrity check and
+                # let a truncated compose pass; the retry layer re-runs
+                # the whole object instead.
+                if composed_size is not None and composed_size != len(view):
+                    raise RuntimeError(
+                        f"GCS composite upload of {path}: composed object "
+                        f"is {composed_size} bytes, expected {len(view)}"
+                    )
+
+            await loop.run_in_executor(self._executor, _compose_and_check)
         finally:
 
             def _best_effort_delete(k):
@@ -161,6 +181,29 @@ class GCSStoragePlugin(StoragePlugin):
     async def list_prefix(self, prefix: str):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._executor, self._list_sync, prefix)
+
+    def _age_sync(self, path: str):
+        import datetime
+        import time as _time
+
+        blob = self._blob(path)
+        blob.reload()
+        updated = getattr(blob, "updated", None)
+        if updated is None:
+            return None
+        if isinstance(updated, (int, float)):
+            return max(0.0, _time.time() - updated)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return max(0.0, (now - updated).total_seconds())
+
+    async def object_age_s(self, path: str):
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._executor, self._age_sync, path
+            )
+        except Exception:
+            return None
 
     def close(self) -> None:
         self._executor.shutdown(wait=True)

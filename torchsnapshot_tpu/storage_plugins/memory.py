@@ -5,9 +5,22 @@ handy model of an object store (flat key → bytes, ranged reads).
 """
 
 import asyncio
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..io_types import IOReq, StoragePlugin
+
+# Shared-store -> mtimes registry. Keyed by id() with a strong reference
+# to the store alongside (keeps the id from being recycled); bounded by
+# the number of distinct in-memory buckets a process creates.
+_MTIMES_BY_STORE: Dict[int, Tuple[dict, Dict[str, float]]] = {}
+
+
+def _mtimes_for(store: dict) -> Dict[str, float]:
+    entry = _MTIMES_BY_STORE.get(id(store))
+    if entry is None or entry[0] is not store:
+        entry = (store, {})
+        _MTIMES_BY_STORE[id(store)] = entry
+    return entry[1]
 
 
 class MemoryStoragePlugin(StoragePlugin):
@@ -15,12 +28,19 @@ class MemoryStoragePlugin(StoragePlugin):
         # A shared dict may be passed in so multiple plugin instances
         # (e.g. simulated ranks) see one "bucket".
         self.store: Dict[str, bytes] = store if store is not None else {}
+        # mtimes are keyed off the SHARED store object, not per-instance:
+        # sweep resolves a fresh plugin instance for the same bucket, and
+        # a per-instance dict would make its age guard a silent no-op.
+        self._mtimes = _mtimes_for(self.store)
         self._lock = asyncio.Lock()
 
     async def write(self, io_req: IOReq) -> None:
+        import time
+
         payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
         async with self._lock:
             self.store[io_req.path] = bytes(payload)
+            self._mtimes[io_req.path] = time.time()
 
     async def read(self, io_req: IOReq) -> None:
         async with self._lock:
@@ -44,6 +64,13 @@ class MemoryStoragePlugin(StoragePlugin):
     async def list_prefix(self, prefix: str):
         async with self._lock:
             return [k for k in self.store if k.startswith(prefix)]
+
+    async def object_age_s(self, path: str):
+        import time
+
+        async with self._lock:
+            mtime = self._mtimes.get(path)
+        return None if mtime is None else max(0.0, time.time() - mtime)
 
     def close(self) -> None:
         pass

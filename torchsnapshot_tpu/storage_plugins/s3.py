@@ -132,6 +132,34 @@ class S3StoragePlugin(StoragePlugin):
             keys = await loop.run_in_executor(self._executor, _list)
         return [k[len(self.root) + 1 :] for k in keys]
 
+    async def object_age_s(self, path: str):
+        import datetime
+
+        def _from_head(head) -> Optional[float]:
+            modified = head.get("LastModified")
+            if modified is None:
+                return None
+            now = datetime.datetime.now(datetime.timezone.utc)
+            return max(0.0, (now - modified).total_seconds())
+
+        try:
+            if self._mode == "aio":
+                async with self._session.create_client("s3") as client:
+                    head = await client.head_object(
+                        Bucket=self.bucket, Key=self._key(path)
+                    )
+                return _from_head(head)
+            loop = asyncio.get_running_loop()
+            head = await loop.run_in_executor(
+                self._executor,
+                lambda: self._client.head_object(
+                    Bucket=self.bucket, Key=self._key(path)
+                ),
+            )
+            return _from_head(head)
+        except Exception:
+            return None
+
     def close(self) -> None:
         if self._mode == "sync":
             self._executor.shutdown(wait=True)
