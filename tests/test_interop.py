@@ -306,3 +306,126 @@ def test_bf16_tree_conversion_bitwise():
     assert torch.equal(back["a"], t)
     assert torch.equal(back["b"][0], t)
     assert back["b"][1] == 5 and back["c"] == "x"
+
+
+# ------------------------------------------------- write-side (convert_back)
+
+
+class _NativeHolder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def test_convert_back_restored_by_reference(ref, tmp_path):
+    """native -> reference format -> restored by the ACTUAL reference
+    library in-process, bitwise (VERDICT r2 ask #8: migration must be
+    reversible). Covers dense fp32 + bf16 arrays, a sharded array
+    (assembled dense), nested containers, an object, and primitives."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.interop.reference_writer import convert_back
+
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    b16 = np.arange(16, dtype=np.float32).astype("bfloat16")
+    devices = np.array(jax.devices()[:4])
+    mesh = Mesh(devices, ("x",))
+    sharded = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        NamedSharding(mesh, P("x", None)),
+    )
+    native_state = {
+        "w": jnp.asarray(w),
+        "b16": jnp.asarray(b16),
+        "sharded": sharded,
+        "nested": {"scale": jnp.full((4,), 2.5)},
+        "steps": [1, 2, 3],
+        "name": "run-b",
+        "epoch": 7,
+    }
+    native = str(tmp_path / "native")
+    Snapshot.take(native, {"m": _NativeHolder(native_state)})
+
+    dest = str(tmp_path / "ref_format")
+    convert_back(native, dest)
+
+    # The reference library restores it. The target stateful hands back
+    # a PLAIN dict: the reference's flatten uses exact type() checks, so
+    # a ref.StateDict would itself be treated as one opaque leaf.
+    class _RefHolder:
+        def __init__(self, sd):
+            self.sd = sd
+
+        def state_dict(self):
+            return self.sd
+
+        def load_state_dict(self, sd):
+            self.sd = sd
+
+    holder = _RefHolder(
+        {
+            "w": torch.zeros(8, 8),
+            "b16": torch.zeros(16, dtype=torch.bfloat16),
+            "sharded": torch.zeros(8, 4),
+            "nested": {"scale": torch.zeros(4)},
+            "steps": [0, 0, 0],
+            "name": "",
+            "epoch": 0,
+        }
+    )
+    ref.Snapshot(dest).restore({"m": holder})
+    target = holder.sd
+
+    torch.testing.assert_close(
+        target["w"], torch.from_numpy(w), rtol=0, atol=0
+    )
+    assert target["b16"].dtype == torch.bfloat16
+    np.testing.assert_array_equal(
+        target["b16"].view(torch.uint16).numpy(),
+        b16.view(np.uint16),
+    )
+    torch.testing.assert_close(
+        target["sharded"],
+        torch.arange(32, dtype=torch.float32).reshape(8, 4),
+        rtol=0,
+        atol=0,
+    )
+    torch.testing.assert_close(
+        target["nested"]["scale"], torch.full((4,), 2.5), rtol=0, atol=0
+    )
+    assert target["steps"] == [1, 2, 3]
+    assert target["name"] == "run-b"
+    assert target["epoch"] == 7
+
+
+def test_convert_back_random_access_via_reference_reader(ref, tmp_path):
+    """The emitted snapshot is also readable by our own reference-format
+    reader — i.e. it IS the reference on-disk schema, not merely
+    something the reference's restore tolerates."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.interop.reference_writer import convert_back
+
+    native = str(tmp_path / "native")
+    Snapshot.take(
+        native,
+        {"m": _NativeHolder({"w": jnp.arange(16.0), "epoch": 3})},
+    )
+    dest = str(tmp_path / "ref_format")
+    convert_back(native, dest)
+
+    reader = ReferenceSnapshotReader(dest)
+    np.testing.assert_array_equal(
+        reader.read("m/w"), np.arange(16, dtype=np.float32)
+    )
+    assert reader.read("m/epoch") == 3
+    reader.close()

@@ -1,0 +1,234 @@
+"""Write-side reference-format interop: export a NATIVE snapshot as a
+snapshot the **reference** torchsnapshot can restore (VERDICT r2 ask #8 —
+migration must be reversible; a torch shop rolling back after a trial
+migration needs a path home).
+
+Emitted format (all cited from the reference):
+- ``.snapshot_metadata`` YAML ``{version, world_size, manifest}``
+  (manifest.py:111-118) with entry dicts exactly as the reference's
+  ``SnapshotMetadata.from_yaml`` reconstructs them (manifest.py:120-154);
+- one ``torch.save`` blob per leaf (io_preparer.py:218, 279), under the
+  reference's location policy ``<rank>/…`` / ``replicated/…``
+  (io_preparer.py:336-342); serializer is always ``"torch_save"``
+  (io_preparer.py:250, 317).
+
+Mapping notes (lossy in documented, deliberate ways):
+- Sharded arrays are ASSEMBLED DENSE and emitted as replicated Tensor
+  entries — every reference rank can restore them into a plain tensor,
+  but the sharded layout itself is not round-tripped (the reference's
+  ShardedTensor restore path requires a live ShardedTensor in the target
+  state dict, which a migrating-back app no longer has).
+- Tuples flatten as lists (the reference has no tuple entry).
+- Primitive entries (beyond-parity inline scalars) become reference
+  object entries with ``torch.save`` payloads.
+- bf16 and other ml_dtypes arrays convert bitwise via the same
+  bit-reinterpretation used on the read side (_torch_convert).
+
+The exporter is collective-free and single-process: run it from one rank
+or an offline tool. Values are materialized to host memory one at a time
+(peak RAM ~ largest single leaf, plus the dense size of the largest
+sharded array).
+"""
+
+import asyncio
+import io
+import logging
+from typing import Any, Dict, Tuple
+
+import numpy as np
+import yaml
+
+from ..io_types import IOReq
+from ..manifest import (
+    ArrayEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    TupleEntry,
+    get_available_entries,
+)
+from ..io_preparer import prepare_read
+from ..scheduler import execute_read_reqs, get_local_memory_budget_bytes
+from ..storage_plugin import url_to_storage_plugin
+from ._torch_convert import _require_torch, numpy_to_torch_tensor
+
+logger = logging.getLogger(__name__)
+
+_METADATA_FNAME = ".snapshot_metadata"
+_REFERENCE_VERSION = "0.0.3"  # reference version.py:17
+
+
+def _torch_save_bytes(obj: Any) -> bytes:
+    torch = _require_torch()
+    buf = io.BytesIO()
+    torch.save(obj, buf)
+    return buf.getvalue()
+
+
+def _to_torch_payload_and_dtype(value: np.ndarray) -> Tuple[bytes, str]:
+    tensor = numpy_to_torch_tensor(np.asarray(value))
+    return _torch_save_bytes(tensor), str(tensor.dtype)
+
+
+def convert_back(native_path: str, dest_path: str) -> None:
+    """Export the native snapshot at ``native_path`` to a
+    reference-torchsnapshot-readable snapshot at ``dest_path``."""
+    from ..snapshot import Snapshot
+
+    storage_in = url_to_storage_plugin(native_path)
+    storage_out = url_to_storage_plugin(dest_path)
+    budget = get_local_memory_budget_bytes()
+    try:
+        metadata = Snapshot(native_path)._read_snapshot_metadata(storage_in)
+        world_size = metadata.world_size
+
+        ref_manifest: Dict[str, Dict[str, Any]] = {}
+        # ref_location -> (native entry to read, logical path); each
+        # payload is read+written once even when its entry is mirrored
+        # into every rank namespace (replicated) or unioned (sharded).
+        pending: Dict[str, Tuple[Any, str]] = {}
+
+        for rank in range(world_size):
+            available = get_available_entries(metadata.manifest, rank)
+            for logical, entry in sorted(available.items()):
+                full = f"{rank}/{logical}"
+                if isinstance(entry, ListEntry):
+                    ref_manifest[full] = {"type": "list"}
+                    continue
+                if isinstance(entry, TupleEntry):
+                    # The reference has no tuple entry; lists inflate in
+                    # the same positions.
+                    ref_manifest[full] = {"type": "list"}
+                    continue
+                if isinstance(entry, OrderedDictEntry):
+                    ref_manifest[full] = {
+                        "type": "OrderedDict",
+                        "keys": list(entry.keys),
+                    }
+                    continue
+                if isinstance(entry, DictEntry):
+                    ref_manifest[full] = {
+                        "type": "dict",
+                        "keys": list(entry.keys),
+                    }
+                    continue
+                if isinstance(entry, PrimitiveEntry):
+                    replicated = bool(entry.replicated)
+                    loc = (
+                        f"replicated/{logical}"
+                        if replicated
+                        else f"{rank}/{logical}"
+                    )
+                    ref_manifest[full] = {
+                        "type": "object",
+                        "location": loc,
+                        "serializer": "torch_save",
+                        "obj_type": entry.ptype,
+                        "replicated": replicated,
+                    }
+                    pending.setdefault(loc, (entry, logical))
+                    continue
+                if isinstance(entry, ShardedArrayEntry):
+                    # Assembled dense, visible to every rank.
+                    loc = f"replicated/{logical}"
+                    ref_manifest[full] = {
+                        "type": "Tensor",
+                        "location": loc,
+                        "serializer": "torch_save",
+                        "dtype": None,  # patched after conversion
+                        "shape": [int(s) for s in entry.shape],
+                        "replicated": True,
+                    }
+                    pending.setdefault(loc, (entry, logical))
+                    continue
+                if isinstance(entry, ArrayEntry):
+                    replicated = bool(entry.replicated)
+                    loc = (
+                        f"replicated/{logical}"
+                        if replicated
+                        else f"{rank}/{logical}"
+                    )
+                    ref_manifest[full] = {
+                        "type": "Tensor",
+                        "location": loc,
+                        "serializer": "torch_save",
+                        "dtype": None,  # patched after conversion
+                        "shape": [int(s) for s in entry.shape],
+                        "replicated": replicated,
+                    }
+                    pending.setdefault(loc, (entry, logical))
+                    continue
+                if isinstance(entry, ObjectEntry):
+                    replicated = bool(getattr(entry, "replicated", False))
+                    loc = (
+                        f"replicated/{logical}"
+                        if replicated
+                        else f"{rank}/{logical}"
+                    )
+                    ref_manifest[full] = {
+                        "type": "object",
+                        "location": loc,
+                        "serializer": "torch_save",
+                        "obj_type": getattr(entry, "obj_type", "object"),
+                        "replicated": replicated,
+                    }
+                    pending.setdefault(loc, (entry, logical))
+                    continue
+                logger.warning(
+                    f"convert_back: skipping {full} (unmapped entry type "
+                    f"{type(entry).__name__})"
+                )
+
+        # Read each unique payload from the native snapshot, convert,
+        # and write it to the destination — one at a time to bound RAM,
+        # all under ONE event loop (per-leaf asyncio.run would build and
+        # tear down ~2 loops per entry — ~100k for a 7B-shaped manifest).
+        dtypes_by_loc: Dict[str, str] = {}
+
+        async def _convert_payloads() -> None:
+            for loc, (entry, logical) in sorted(pending.items()):
+                if isinstance(entry, PrimitiveEntry):
+                    payload = _torch_save_bytes(entry.get_value())
+                else:
+                    holder: Dict[str, Any] = {}
+                    reqs, finalizers = prepare_read(
+                        entry=entry,
+                        template=None,
+                        callback=lambda v: holder.update(v=v),
+                    )
+                    await execute_read_reqs(reqs, storage_in, budget, rank=0)
+                    for fin in finalizers:
+                        fin()
+                    value = holder["v"]
+                    if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
+                        payload, dtype = _to_torch_payload_and_dtype(value)
+                        dtypes_by_loc[loc] = dtype
+                    else:
+                        payload = _torch_save_bytes(value)
+                await storage_out.write(IOReq(path=loc, data=payload))
+
+            for entry_dict in ref_manifest.values():
+                if entry_dict.get("type") == "Tensor":
+                    entry_dict["dtype"] = dtypes_by_loc[
+                        entry_dict["location"]
+                    ]
+
+            doc = yaml.dump(
+                {
+                    "version": _REFERENCE_VERSION,
+                    "world_size": world_size,
+                    "manifest": ref_manifest,
+                },
+                sort_keys=False,
+            )
+            meta_req = IOReq(path=_METADATA_FNAME)
+            meta_req.buf.write(doc.encode("utf-8"))
+            await storage_out.write(meta_req)
+
+        asyncio.run(_convert_payloads())
+    finally:
+        storage_in.close()
+        storage_out.close()

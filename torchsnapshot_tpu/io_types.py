@@ -290,12 +290,12 @@ class RetryingStoragePlugin(StoragePlugin):
         )
 
     async def object_age_s(self, path: str) -> Optional[float]:
-        # No retry: age is advisory (None = unknown) and callers treat
-        # failures the same way.
-        try:
-            return await self._inner.object_age_s(path)
-        except Exception:
-            return None
+        # Retried like reads; a final failure propagates so the sweep
+        # age guard can fail closed (spare the object) instead of
+        # treating a throttled probe as "unknown age, sweep it".
+        return await retry_storage_op(
+            lambda: self._inner.object_age_s(path), f"age({path})"
+        )
 
     def close(self) -> None:
         self._inner.close()

@@ -478,6 +478,18 @@ class Snapshot:
         that cannot report object age sweep unconditionally (set the env
         var to 0 to force that everywhere, e.g. in tests).
         """
+        # Parse config BEFORE any destructive work: a malformed value
+        # must surface as a config error, not abort a half-done delete.
+        try:
+            min_age_s = float(
+                os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600)
+            )
+        except ValueError as e:
+            raise ValueError(
+                f"Malformed TPUSNAPSHOT_SWEEP_MIN_AGE_S="
+                f"{os.environ['TPUSNAPSHOT_SWEEP_MIN_AGE_S']!r}: expected "
+                f"seconds as a number"
+            ) from e
         storage = url_to_storage_plugin(self.path)
         try:
             try:
@@ -525,9 +537,6 @@ class Snapshot:
                             f"from interrupted takes may remain."
                         )
                         return
-                    min_age_s = float(
-                        os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600)
-                    )
                     known = locations | set(markers)
 
                     async def _sweep_one(path: str) -> None:
@@ -538,10 +547,18 @@ class Snapshot:
                         # INSIDE the semaphore: on cloud backends each
                         # probe is a HEAD request (the S3 aio path opens a
                         # client per call) and thousands of orphans must
-                        # not fan out unbounded.
+                        # not fan out unbounded. A probe FAILURE fails
+                        # closed — the orphan is spared, not swept blind.
                         async with sem:
                             if path not in known and min_age_s > 0:
-                                age = await storage.object_age_s(path)
+                                try:
+                                    age = await storage.object_age_s(path)
+                                except Exception as e:
+                                    logger.warning(
+                                        f"sweep: sparing {path} (age "
+                                        f"probe failed: {e!r})"
+                                    )
+                                    return
                                 if age is not None and age < min_age_s:
                                     logger.info(
                                         f"sweep: sparing {path} "

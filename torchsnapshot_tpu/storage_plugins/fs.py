@@ -94,8 +94,10 @@ class FSStoragePlugin(StoragePlugin):
 
         try:
             st = os.stat(os.path.join(self.root, path))
-        except OSError:
-            return None
+        except FileNotFoundError:
+            return None  # vanished: deleting a missing object is a no-op
+        # Other OSErrors (stale NFS handle, perms) propagate: the sweep
+        # age guard fails closed on them instead of sweeping blind.
         return max(0.0, time.time() - st.st_mtime)
 
     def close(self) -> None:

@@ -197,13 +197,20 @@ class GCSStoragePlugin(StoragePlugin):
         return max(0.0, (now - updated).total_seconds())
 
     async def object_age_s(self, path: str):
+        from ..io_types import is_not_found_error
+
         loop = asyncio.get_running_loop()
         try:
             return await loop.run_in_executor(
                 self._executor, self._age_sync, path
             )
-        except Exception:
-            return None
+        except Exception as e:
+            # Missing object: unknown age is fine (delete is idempotent).
+            # Transient failures propagate so the sweep guard fails
+            # closed instead of deleting possibly-fresh objects.
+            if is_not_found_error(e):
+                return None
+            raise
 
     def close(self) -> None:
         self._executor.shutdown(wait=True)

@@ -5,14 +5,20 @@ handy model of an object store (flat key → bytes, ranged reads).
 """
 
 import asyncio
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 from ..io_types import IOReq, StoragePlugin
 
 # Shared-store -> mtimes registry. Keyed by id() with a strong reference
-# to the store alongside (keeps the id from being recycled); bounded by
-# the number of distinct in-memory buckets a process creates.
-_MTIMES_BY_STORE: Dict[int, Tuple[dict, Dict[str, float]]] = {}
+# to the store alongside (keeps the id from being recycled). LRU-bounded:
+# holding every store ever constructed would pin all their payload bytes
+# for the process lifetime; evicted stores degrade to age-unknown, which
+# sweeps unconditionally — the pre-age-guard behavior.
+_MTIMES_MAX_STORES = 64
+_MTIMES_BY_STORE: "OrderedDict[int, Tuple[dict, Dict[str, float]]]" = (
+    OrderedDict()
+)
 
 
 def _mtimes_for(store: dict) -> Dict[str, float]:
@@ -20,6 +26,9 @@ def _mtimes_for(store: dict) -> Dict[str, float]:
     if entry is None or entry[0] is not store:
         entry = (store, {})
         _MTIMES_BY_STORE[id(store)] = entry
+    _MTIMES_BY_STORE.move_to_end(id(store))
+    while len(_MTIMES_BY_STORE) > _MTIMES_MAX_STORES:
+        _MTIMES_BY_STORE.popitem(last=False)
     return entry[1]
 
 
@@ -60,6 +69,7 @@ class MemoryStoragePlugin(StoragePlugin):
             if path not in self.store:
                 raise FileNotFoundError(path)
             del self.store[path]
+            self._mtimes.pop(path, None)
 
     async def list_prefix(self, prefix: str):
         async with self._lock:

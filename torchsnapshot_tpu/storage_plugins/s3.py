@@ -142,6 +142,8 @@ class S3StoragePlugin(StoragePlugin):
             now = datetime.datetime.now(datetime.timezone.utc)
             return max(0.0, (now - modified).total_seconds())
 
+        from ..io_types import is_not_found_error
+
         try:
             if self._mode == "aio":
                 async with self._session.create_client("s3") as client:
@@ -157,8 +159,14 @@ class S3StoragePlugin(StoragePlugin):
                 ),
             )
             return _from_head(head)
-        except Exception:
-            return None
+        except Exception as e:
+            # Vanished object: fine to report unknown (deleting a missing
+            # object is a no-op). Any OTHER failure must propagate — the
+            # sweep age guard fails CLOSED on it (sparing the object)
+            # rather than treating a throttled HEAD as "no age, sweep it".
+            if is_not_found_error(e):
+                return None
+            raise
 
     def close(self) -> None:
         if self._mode == "sync":
