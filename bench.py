@@ -113,23 +113,28 @@ def _probe_h2d_gbps() -> float:
     restore path itself uses (measured on this platform: chunked sustains
     ~1.4x a single large device_put, so a plain-put probe would understate
     the ceiling), synced by a forced device reduction (device_put returns
-    before bytes cross the link here). Best of two; the first also warms
-    the reduction's and concatenate's compiles."""
+    before bytes cross the link here). Best of two, each with a FRESH
+    host buffer: re-putting the same array measures a cached/pinned
+    staging path 2-3x faster than moving new bytes (measured r3), which
+    is not what a restore does. The first run also warms the reduction's
+    and concatenate's compiles."""
     import numpy as np
 
     from torchsnapshot_tpu.ops.transfer import chunked_device_put
 
-    host = np.ones((16 * 1024 * 1024,), dtype=np.float32)
     device = jax.devices()[0]
     force = jax.jit(jnp.sum)
+    rng = np.random.default_rng(11)
     best = 0.0
     for _ in range(2):
+        host = rng.standard_normal(16 * 1024 * 1024, dtype=np.float32)
         begin = time.monotonic()
         arr = chunked_device_put(host, device)
         float(force(arr))
         elapsed = time.monotonic() - begin
         best = max(best, host.nbytes / 1024**3 / elapsed)
         arr.delete()
+        del host
     return best
 
 

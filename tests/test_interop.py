@@ -360,17 +360,7 @@ def test_convert_back_restored_by_reference(ref, tmp_path):
     # The reference library restores it. The target stateful hands back
     # a PLAIN dict: the reference's flatten uses exact type() checks, so
     # a ref.StateDict would itself be treated as one opaque leaf.
-    class _RefHolder:
-        def __init__(self, sd):
-            self.sd = sd
-
-        def state_dict(self):
-            return self.sd
-
-        def load_state_dict(self, sd):
-            self.sd = sd
-
-    holder = _RefHolder(
+    holder = _NativeHolder(
         {
             "w": torch.zeros(8, 8),
             "b16": torch.zeros(16, dtype=torch.bfloat16),
@@ -428,4 +418,30 @@ def test_convert_back_random_access_via_reference_reader(ref, tmp_path):
         reader.read("m/w"), np.arange(16, dtype=np.float32)
     )
     assert reader.read("m/epoch") == 3
+    reader.close()
+
+
+def test_convert_back_handles_prng_key_arrays(ref, tmp_path):
+    """PRNG key arrays are routine training state; convert_back exports
+    their raw uint32 key data (torch has no key-array notion)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.interop.reference_writer import convert_back
+
+    key = jax.random.key(42)
+    native = str(tmp_path / "native")
+    Snapshot.take(
+        native,
+        {"m": _NativeHolder({"rngkey": key, "w": jnp.arange(4.0)})},
+    )
+    dest = str(tmp_path / "ref_format")
+    convert_back(native, dest)
+
+    reader = ReferenceSnapshotReader(dest)
+    got = reader.read("m/rngkey")
+    np.testing.assert_array_equal(
+        got, np.asarray(jax.random.key_data(key))
+    )
     reader.close()

@@ -204,6 +204,14 @@ def convert_back(native_path: str, dest_path: str) -> None:
                         fin()
                     value = holder["v"]
                     if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
+                        if getattr(entry, "prng_impl", None) is not None:
+                            # PRNG key arrays cannot convert to numpy
+                            # directly; export the raw uint32 key data
+                            # (which the manifest's shape/dtype already
+                            # describe) — torch has no key-array notion.
+                            import jax as _jax
+
+                            value = _jax.random.key_data(value)
                         payload, dtype = _to_torch_payload_and_dtype(value)
                         dtypes_by_loc[loc] = dtype
                     else:
