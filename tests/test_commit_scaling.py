@@ -201,3 +201,43 @@ def test_sync_commit_storage_route_world8_and_16():
     t16 = _measure_storage_commit(world=16)
     assert t8 < 30.0 and t16 < 45.0
     assert t16 < max(8 * t8, 10.0), f"world 8->16 blew up: {t8:.2f}s -> {t16:.2f}s"
+
+
+def test_commit_marker_collection_names_every_straggler(tmp_path):
+    """If some ranks never write their completion marker (crashed
+    mid-take), the commit poll times out with an error naming EVERY
+    straggler — at pod scale "ranks 2 and 3" localizes the failure,
+    "rank 2" alone does not. Exercised for the sync storage-route via
+    the shared _acommit_via_storage collection helper."""
+    import pytest
+
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    shared = {}
+    storage = MemoryStoragePlugin(shared)
+    world = 4
+    # Ranks 0 and 1 committed (markers written directly — rank 0's
+    # _acommit_via_storage would poll for everyone); 2 and 3 crashed.
+    for rank in (0, 1):
+        marker = snapmod.IOReq(path=f".completed/nonce-x/{rank}")
+        marker.buf.write(
+            snapmod._encode_metadata_doc(
+                SnapshotMetadata(
+                    version="v",
+                    world_size=world,
+                    manifest={},
+                    take_id="nonce-x",
+                ).to_yaml()
+            )
+        )
+        asyncio.run(storage.write(marker))
+
+    with pytest.raises(TimeoutError) as exc_info:
+        asyncio.run(
+            snapmod._collect_completion_manifests(
+                storage, world, "nonce-x", timeout_s=0.5
+            )
+        )
+    message = str(exc_info.value)
+    assert "[2, 3]" in message
+    assert "NOT committed" in message
