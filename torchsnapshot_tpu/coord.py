@@ -26,6 +26,7 @@ coordination-service values have size limits (SURVEY §7 hard part #3).
 """
 
 import abc
+import base64
 import os
 import pickle
 import tempfile
@@ -158,15 +159,11 @@ class JaxStore(Store):
         self._client = client
 
     def set(self, key: str, value: bytes) -> None:
-        import base64
-
         self._client.key_value_set(
             key, base64.b64encode(value).decode("ascii")
         )
 
     def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
-        import base64
-
         val = self._client.blocking_key_value_get(key, int(timeout_s * 1000))
         return base64.b64decode(val.encode("ascii"), validate=True)
 
