@@ -445,3 +445,26 @@ def test_convert_back_handles_prng_key_arrays(ref, tmp_path):
         got, np.asarray(jax.random.key_data(key))
     )
     reader.close()
+
+
+def test_inspect_cli_convert_back(ref, tmp_path, capsys):
+    """Operator surface: python -m torchsnapshot_tpu.inspect <native>
+    --convert-back <dest> exports reference format."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.inspect import main
+
+    native = str(tmp_path / "native")
+    Snapshot.take(native, {"m": _NativeHolder({"w": jnp.arange(8.0)})})
+    dest = str(tmp_path / "ref")
+    assert main([native, "--convert-back", dest]) == 0
+    assert "exported" in capsys.readouterr().out
+
+    np.testing.assert_array_equal(
+        ReferenceSnapshotReader(dest).read("m/w"),
+        np.arange(8, dtype=np.float32),
+    )
+
+    with pytest.raises(SystemExit):
+        main([native, "--convert-back", dest, "--verify"])

@@ -79,13 +79,31 @@ def main(argv=None) -> int:
         help="scrub every payload against its manifest checksum/length; "
         "exit 1 if any object is bad",
     )
+    parser.add_argument(
+        "--convert-back",
+        metavar="DEST",
+        help="export this native snapshot to reference-torchsnapshot "
+        "format at DEST (torch_save payloads + YAML metadata; sharded "
+        "arrays assemble dense) — the reverse-migration path",
+    )
     args = parser.parse_args(argv)
 
-    if args.verify and (args.delete or args.sweep):
+    exclusive = [
+        bool(args.verify),
+        bool(args.delete or args.sweep),
+        bool(args.convert_back),
+    ]
+    if sum(exclusive) > 1:
         parser.error(
-            "--verify cannot be combined with --delete/--sweep; scrub "
-            "first, then delete in a separate invocation"
+            "--verify, --delete/--sweep, and --convert-back are mutually "
+            "exclusive; run them in separate invocations"
         )
+    if args.convert_back:
+        from .interop.reference_writer import convert_back
+
+        convert_back(args.path, args.convert_back)
+        print(f"exported {args.path} -> {args.convert_back} (reference format)")
+        return 0
     if args.verify:
         problems = Snapshot(args.path).verify()
         if not problems:
