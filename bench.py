@@ -460,25 +460,61 @@ def main() -> None:
         # Warm the reduction's compile outside the timed window.
         float(force_sum([target.params[p.split("/", 1)[1]] for p in restore_paths]))
 
-        # H2D ceiling probe ADJACENT to the restore timing, so
-        # restore/ceiling pairs measurements from the same tenancy
-        # moment (restore is gated by sustained H2D).
-        h2d_gbps = _probe_h2d_gbps()
-        print(
-            f"[bench] H2D probe ceiling: {h2d_gbps:.4f} GB/s",
-            file=sys.stderr,
-        )
-
-        restore_begin = time.monotonic()
-        Snapshot(f"{bench_dir}/snap").restore(
-            {"model": target}, paths=restore_paths
-        )
-        float(
-            force_sum(
-                [target.params[p.split("/", 1)[1]] for p in restore_paths]
+        # The restore timing is BRACKETED by H2D probes: the restore
+        # window is tens of seconds on a link that swings
+        # minute-to-minute, and a single adjacent probe would
+        # misattribute a mid-window collapse (or recovery) to the code.
+        # If the two probes disagree by more than 2x, the window was
+        # unstable — retry once; the attempt with the tighter probe
+        # spread is reported, and the spread itself goes in the JSON so
+        # a reader can judge the ratio's reliability.
+        def _timed_restore():
+            target.params = {
+                k: jnp.zeros_like(v) for k, v in model.params.items()
+            }
+            jax.block_until_ready(list(target.params.values()))
+            before = _probe_h2d_gbps()
+            begin = time.monotonic()
+            Snapshot(f"{bench_dir}/snap").restore(
+                {"model": target}, paths=restore_paths
             )
+            float(
+                force_sum(
+                    [target.params[p.split("/", 1)[1]] for p in restore_paths]
+                )
+            )
+            elapsed = time.monotonic() - begin
+            after = _probe_h2d_gbps()
+            spread = max(before, after) / max(min(before, after), 1e-9)
+            print(
+                f"[bench] restore {elapsed:.2f}s; H2D probes "
+                f"{before:.4f}/{after:.4f} GB/s (spread {spread:.2f}x)",
+                file=sys.stderr,
+            )
+            # The CEILING is the better probe (same convention as the
+            # D2H probe: interference only subtracts) — a mean could
+            # report restore/ceiling above 1.0, which is meaningless.
+            return elapsed, max(before, after), spread
+
+        restore_elapsed, h2d_gbps, h2d_spread = _timed_restore()
+        budget_remaining_s = total_budget_s - (
+            time.monotonic() - bench_start
         )
-        restore_elapsed = time.monotonic() - restore_begin
+        if (
+            h2d_spread > 2.0
+            and not over_budget
+            # A retry re-runs a full restore + two probes; only attempt
+            # it when that plausibly fits what remains of the budget.
+            and budget_remaining_s > 2.5 * restore_elapsed
+        ):
+            print(
+                "[bench] H2D probes disagree >2x (unstable window); "
+                "re-timing the restore once",
+                file=sys.stderr,
+            )
+            retry = _timed_restore()
+            if retry[2] < h2d_spread:
+                restore_elapsed, h2d_gbps, h2d_spread = retry
         restored_gib = n_restore * param_bytes / 1024**3
         restore_gbps = restored_gib / restore_elapsed
         restore_vs_ceiling = restore_gbps / max(h2d_gbps, 1e-9)
@@ -525,6 +561,7 @@ def main() -> None:
                     "async_stall_pct": round(100 * async_stall / elapsed, 2),
                     "restore_GBps": round(restore_gbps, 4),
                     "h2d_ceiling_GBps": round(h2d_gbps, 4),
+                    "h2d_probe_spread": round(h2d_spread, 2),
                     "restore_vs_ceiling": round(restore_vs_ceiling, 3),
                     "restore_bytes": int(restored_gib * 1024**3),
                     "n_take_runs": len(times),
