@@ -468,3 +468,72 @@ def test_inspect_cli_convert_back(ref, tmp_path, capsys):
 
     with pytest.raises(SystemExit):
         main([native, "--convert-back", dest, "--verify"])
+
+
+def test_convert_back_multi_rank(ref, tmp_path):
+    """A world-2 native snapshot (per-rank + replicated entries) exports
+    with the reference's rank-prefixed namespace intact: per-rank values
+    stay per-rank, replicated values resolve for every rank, and the
+    actual reference library restores each rank's view."""
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.interop.reference_writer import convert_back
+    from torchsnapshot_tpu.utils.test_utils import run_thread_ranks
+
+    native = str(tmp_path / "native")
+
+    def worker(coord, rank):
+        Snapshot.take(
+            native,
+            {
+                "m": _NativeHolder(
+                    {
+                        "mine": np.full((4,), rank, dtype=np.float32),
+                        "shared": np.arange(8, dtype=np.float32),
+                    }
+                )
+            },
+            coord=coord,
+            replicated=["m/shared"],
+        )
+
+    run_thread_ranks(2, worker)
+    dest = str(tmp_path / "ref_format")
+    convert_back(native, dest)
+
+    class _TorchHolder:
+        def __init__(self):
+            # Sentinels, NOT the expected values: a restore that
+            # silently skips an entry must fail the assertions below.
+            self.sd = {
+                "mine": torch.full((4,), -1.0),
+                "shared": torch.full((8,), -1.0),
+            }
+
+        def state_dict(self):
+            return self.sd
+
+        def load_state_dict(self, sd):
+            self.sd = sd
+
+    # Reference restore is rank 0 in this process; rank 1's view is
+    # checked through the reader (no second process needed).
+    holder = _TorchHolder()
+    ref.Snapshot(dest).restore({"m": holder})
+    torch.testing.assert_close(
+        holder.sd["mine"], torch.zeros(4), rtol=0, atol=0
+    )
+    torch.testing.assert_close(
+        holder.sd["shared"],
+        torch.arange(8, dtype=torch.float32),
+        rtol=0,
+        atol=0,
+    )
+
+    reader = ReferenceSnapshotReader(dest)
+    np.testing.assert_array_equal(
+        reader.read("m/mine", rank=1), np.full((4,), 1, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(
+        reader.read("m/shared", rank=1), np.arange(8, dtype=np.float32)
+    )
+    reader.close()
