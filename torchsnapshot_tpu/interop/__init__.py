@@ -14,8 +14,14 @@ Two migration paths for users switching from the reference
   ``torch.Tensor`` leaves) so it snapshots/restores through this
   framework bit-exactly, bfloat16 included.
 
-torch is an optional dependency of this subpackage only; the core
-framework never imports it.
+A third path covers the JAX ecosystem's incumbent checkpointer:
+``interop.orbax_format.convert_from_orbax`` / ``convert_to_orbax``
+migrate between orbax ``PyTreeCheckpointer`` checkpoints and native
+snapshots (see that module).
+
+torch and orbax are optional dependencies of this subpackage only; the
+core framework never imports them. ``reference_writer.convert_back``
+(the reverse torch migration) likewise lives in its own module.
 """
 
 from .reference_format import ReferenceSnapshotReader

@@ -1,0 +1,143 @@
+"""Orbax interop: migrate between orbax checkpoints and native snapshots.
+
+The reference's ecosystem boundary is torch (`reference_format.py` /
+`reference_writer.py`); the JAX ecosystem's incumbent checkpointer is
+**orbax** (`orbax.checkpoint`), so a TPU-native framework owes its users
+the same two-way path there:
+
+- :func:`convert_from_orbax` — read an orbax ``PyTreeCheckpointer``
+  checkpoint (OCDBT/tensorstore on disk — parsed by orbax itself, never
+  by hand) and write a native snapshot, gaining this framework's
+  surface over the same state: per-leaf random access
+  (``read_object``), integrity scrub (``verify``), GC
+  (``delete(sweep=True)``), reference-format export (``convert_back``).
+- :func:`convert_to_orbax` — materialize a native snapshot's state to
+  host values and save it as an orbax checkpoint, so a team trialing
+  this framework can roll back to orbax as easily as a torch shop can
+  roll back to the reference.
+
+Both are single-process offline tools (collective-free): sharded arrays
+resolve through the manifest's availability union, so any rank layout
+converts. orbax is an optional dependency of this module only; the core
+framework never imports it.
+"""
+
+from typing import Any, Optional
+
+_DEFAULT_STATEFUL_KEY = "state"
+
+
+def _require_orbax() -> Any:
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "orbax interop requires the orbax-checkpoint package."
+        ) from e
+    return ocp
+
+
+class _TreeHolder:
+    """Stateful over a plain pytree (state_dict IS the tree)."""
+
+    def __init__(self, tree: Any) -> None:
+        self.tree = tree
+
+    def state_dict(self) -> Any:
+        return self.tree
+
+    def load_state_dict(self, tree: Any) -> None:
+        self.tree = tree
+
+
+def convert_from_orbax(
+    orbax_path: str,
+    native_path: str,
+    stateful_key: str = _DEFAULT_STATEFUL_KEY,
+    compression: Optional[str] = None,
+) -> "Any":
+    """Convert an orbax ``PyTreeCheckpointer`` checkpoint to a native
+    snapshot; returns the :class:`Snapshot` handle.
+
+    The restored pytree becomes the state of one stateful named
+    ``stateful_key`` (leaves appear as ``"<stateful_key>/<path>"`` in
+    the native manifest, matching how an app that owned the tree would
+    have snapshotted it)."""
+    from ..snapshot import Snapshot
+
+    ocp = _require_orbax()
+    tree = ocp.PyTreeCheckpointer().restore(orbax_path)
+    return Snapshot.take(
+        native_path, {stateful_key: _TreeHolder(tree)}, compression=compression
+    )
+
+
+def convert_to_orbax(
+    native_path: str,
+    orbax_path: str,
+    stateful_key: Optional[str] = None,
+    rank: int = 0,
+    allow_partial: bool = False,
+) -> None:
+    """Export a native snapshot as an orbax checkpoint.
+
+    ``stateful_key`` selects one top-level stateful to export as the
+    checkpoint's pytree (the natural shape when the snapshot came from
+    :func:`convert_from_orbax` or holds a single train state). With
+    ``None``, every top-level stateful exports under its own key —
+    ``{key: tree, ...}`` — so multi-stateful app states round-trip too.
+
+    Values are materialized to HOST (numpy/objects): replicated values
+    resolve for every rank and sharded arrays assemble dense through
+    the availability union, so those layouts export from any world
+    size. An orbax checkpoint is ONE pytree with no rank dimension, so
+    the export is ``rank``'s view — and it REFUSES (like
+    ``ReferenceSnapshotReader.convert``) when other ranks own per-rank
+    values that would be silently dropped. To deliberately export one
+    rank's view anyway (e.g. each rank to its own checkpoint), pass
+    ``allow_partial=True``.
+    """
+    from ..manifest import ShardedArrayEntry, is_replicated
+    from ..snapshot import Snapshot
+
+    ocp = _require_orbax()
+    snap = Snapshot(native_path)
+    manifest = snap.get_manifest()
+
+    # Per-rank = carries a replicated flag that is False and is not
+    # sharded. (Containers carry no flag; primitives are INLINE — no
+    # location — but a per-rank primitive is still another rank's data.)
+    foreign = sorted(
+        full
+        for full, entry in manifest.items()
+        if "/" in full
+        and full.split("/", 1)[0] != str(rank)
+        and not isinstance(entry, ShardedArrayEntry)
+        and hasattr(entry, "replicated")
+        and not is_replicated(entry)
+    )
+    if foreign and not allow_partial:
+        preview = ", ".join(foreign[:5])
+        raise RuntimeError(
+            f"This snapshot holds per-rank values owned by ranks other "
+            f"than {rank} (e.g. {preview}); an orbax checkpoint is one "
+            f"pytree with no rank dimension, so exporting rank {rank}'s "
+            f"view would silently drop them. Pass allow_partial=True to "
+            f"deliberately export this rank's view (e.g. each rank to "
+            f"its own checkpoint via rank=R)."
+        )
+
+    # Top-level stateful keys, rank-agnostic: "0/model/..." -> "model".
+    top_keys = sorted(
+        {full.split("/", 2)[1] for full in manifest if "/" in full}
+    )
+    if stateful_key is not None:
+        if stateful_key not in top_keys:
+            raise KeyError(
+                f'"{stateful_key}" is not a top-level stateful of this '
+                f"snapshot; available: {top_keys}"
+            )
+        tree = snap.read_object(stateful_key, rank=rank)
+    else:
+        tree = {key: snap.read_object(key, rank=rank) for key in top_keys}
+    ocp.PyTreeCheckpointer().save(orbax_path, tree)
