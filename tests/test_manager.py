@@ -1,0 +1,122 @@
+"""CheckpointManager tests: step markers, retention, latest-resolution,
+async finalization, multi-rank agreement (beyond reference parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict
+from torchsnapshot_tpu.utils.test_utils import run_thread_ranks
+
+
+def _state(v):
+    return {"s": StateDict(w=jnp.full((8,), float(v)))}
+
+
+def _target():
+    return {"s": StateDict(w=jnp.zeros((8,)))}
+
+
+def test_save_restore_latest_and_explicit(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    for step in (0, 100, 200):
+        mgr.save(step, _state(step))
+    assert mgr.all_steps() == [0, 100, 200]
+    assert mgr.latest_step() == 200
+
+    target = _target()
+    assert mgr.restore(target) == 200
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 200.0)
+
+    target = _target()
+    assert mgr.restore(target, step=100) == 100
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 100.0)
+
+
+def test_retention_prunes_old_steps(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = tmp_path / "run"
+    mgr = CheckpointManager(str(base), max_to_keep=2)
+    for step in range(5):
+        mgr.save(step, _state(step))
+    assert mgr.all_steps() == [3, 4]
+    # Pruned step dirs hold no files (markers AND payloads gone).
+    for step in range(3):
+        leftovers = [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(base / f"step-{step}")
+            for f in fs
+        ]
+        assert leftovers == [], f"step {step} not pruned: {leftovers}"
+    # Retained steps still restore.
+    target = _target()
+    assert mgr.restore(target) == 4
+
+
+def test_latest_ignores_uncommitted_dirs(tmp_path):
+    """A step directory without a marker (crashed mid-take) is invisible
+    to latest_step/restore — the marker is the manager-level commit."""
+    base = tmp_path / "run"
+    mgr = CheckpointManager(str(base))
+    mgr.save(10, _state(10))
+    # A crashed later take: payload dir exists, no marker.
+    Snapshot.take(str(base / "step-20"), _state(20))
+    os.remove(base / "step-20" / ".snapshot_metadata")
+    (base / "step-20" / "junk").write_bytes(b"x")
+    assert mgr.latest_step() == 10
+    target = _target()
+    assert mgr.restore(target) == 10
+
+
+def test_restore_empty_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "none"))
+    with pytest.raises(FileNotFoundError, match="No committed checkpoints"):
+        mgr.restore(_target())
+
+
+def test_async_save_finalizes_on_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=1)
+    pending = mgr.async_save(7, _state(7))
+    handle_snapshot = pending.wait()
+    assert mgr.all_steps() == [7]
+    target = _target()
+    assert mgr.restore(target) == 7
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 7.0)
+    assert handle_snapshot.verify() == {}
+    # A second async save prunes the first after wait().
+    mgr.async_save(8, _state(8)).wait()
+    assert mgr.all_steps() == [8]
+
+
+def test_multi_rank_save_restore(tmp_path, monkeypatch):
+    """Every rank calls save/restore; markers and pruning are rank-0
+    duties; restore(step=None) agrees across ranks via broadcast."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = str(tmp_path / "run")
+
+    def worker(coord, rank):
+        mgr = CheckpointManager(base, max_to_keep=2, coord=coord)
+        for step in range(3):
+            mgr.save(
+                step,
+                {"s": StateDict(mine=np.full((4,), rank + step * 10.0))},
+            )
+        target = {"s": StateDict(mine=np.zeros((4,)))}
+        restored_step = mgr.restore(target)
+        assert restored_step == 2
+        np.testing.assert_array_equal(
+            np.asarray(target["s"]["mine"]), rank + 20.0
+        )
+        return restored_step
+
+    assert run_thread_ranks(2, worker) == [2, 2]
+    assert CheckpointManager(base).all_steps() == [1, 2]
+
+
+def test_max_to_keep_validation(tmp_path):
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointManager(str(tmp_path), max_to_keep=0)

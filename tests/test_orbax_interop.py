@@ -184,3 +184,26 @@ def test_native_to_orbax_refuses_foreign_per_rank(tmp_path):
             np.arange(8, dtype=np.float32),
         )
         assert restored["m"]["count"] == rank
+
+
+def test_allow_partial_skips_foreign_stateful(tmp_path):
+    """A stateful owned entirely by another rank exports as ABSENT (with
+    a warning) under allow_partial, instead of raising mid-export."""
+    from torchsnapshot_tpu.utils.test_utils import run_thread_ranks
+
+    native = str(tmp_path / "native")
+
+    def worker(coord, rank):
+        state = {"m": _Holder({"w": np.arange(4, dtype=np.float32)})}
+        if rank == 1:
+            state["sched"] = _Holder({"t": np.float32(0.5)})
+        Snapshot.take(native, state, coord=coord, replicated=["m/w"])
+
+    run_thread_ranks(2, worker)
+    out = str(tmp_path / "partial")
+    convert_to_orbax(native, out, rank=0, allow_partial=True)
+    restored = ocp.PyTreeCheckpointer().restore(out)
+    assert "sched" not in restored
+    np.testing.assert_array_equal(
+        np.asarray(restored["m"]["w"]), np.arange(4, dtype=np.float32)
+    )

@@ -14,6 +14,7 @@ from .coord import (
     StoreCoordinator,
     get_coordinator,
 )
+from .manager import CheckpointManager, PendingManagedSnapshot
 from .rng_state import RNGState
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import StateDict
@@ -23,6 +24,8 @@ from .version import __version__
 
 __all__ = [
     "AppState",
+    "CheckpointManager",
+    "PendingManagedSnapshot",
     "Coordinator",
     "DictStore",
     "FileStore",

@@ -22,7 +22,10 @@ converts. orbax is an optional dependency of this module only; the core
 framework never imports it.
 """
 
+import logging
 from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
 
 _DEFAULT_STATEFUL_KEY = "state"
 
@@ -139,5 +142,19 @@ def convert_to_orbax(
             )
         tree = snap.read_object(stateful_key, rank=rank)
     else:
-        tree = {key: snap.read_object(key, rank=rank) for key in top_keys}
+        tree = {}
+        for key in top_keys:
+            try:
+                tree[key] = snap.read_object(key, rank=rank)
+            except KeyError:
+                # A stateful (or leaves of one) owned entirely by another
+                # rank does not resolve for `rank`. Under allow_partial
+                # that is exactly the data the caller agreed to drop;
+                # without it, the foreign check above already raised.
+                if not allow_partial:
+                    raise
+                logger.warning(
+                    f"convert_to_orbax: skipping stateful {key!r} "
+                    f"(not resolvable for rank {rank})"
+                )
     ocp.PyTreeCheckpointer().save(orbax_path, tree)

@@ -1,0 +1,244 @@
+"""CheckpointManager: step-indexed snapshots with retention (beyond
+reference parity — the reference leaves step naming, latest-resolution,
+and retention entirely to the user; the JAX ecosystem's expectation is
+orbax's ``CheckpointManager``, so a TPU-native framework should ship the
+same layer).
+
+One manager owns a base path. Each ``save(step, app_state)`` takes a
+snapshot at ``<base>/step-<step>``; after the snapshot COMMITS, rank 0
+records a small step marker under ``<base>/.steps/<step>`` and prunes
+beyond ``max_to_keep``. Markers — not directory listings — define which
+steps exist:
+
+- an interrupted take leaves no marker, so ``latest_step()`` /
+  ``restore()`` can never resolve a half-written snapshot (the marker is
+  the manager-level commit point, layered above the snapshot-level
+  metadata-last commit);
+- listing ``.steps/`` is O(retained steps), never a scan of payload
+  objects.
+
+Multi-process discipline: every rank calls ``save``/``restore`` (they
+run the usual snapshot collectives); marker writes and pruning happen on
+rank 0 only, and ``restore(step=None)`` resolves the latest step on
+rank 0 and broadcasts it so ranks can never pick different steps while a
+prune races the listing.
+
+``async_save`` returns a handle whose ``wait()`` finalizes the marker
+and pruning after the background drain commits — the training loop
+keeps the sub-second stall of ``Snapshot.async_take``.
+"""
+
+import asyncio
+import logging
+from typing import Any, List, Optional
+
+from .coord import Coordinator, get_coordinator
+from .io_types import IOReq, is_not_found_error
+from .snapshot import PendingSnapshot, Snapshot
+from .stateful import AppState
+from .storage_plugin import url_to_storage_plugin
+
+logger = logging.getLogger(__name__)
+
+_STEP_PREFIX = ".steps/"
+
+
+def _step_dir(base_path: str, step: int) -> str:
+    return f"{base_path}/step-{step}"
+
+
+class CheckpointManager:
+    """Step-indexed snapshot lifecycle over one base path.
+
+    Usage::
+
+        mgr = CheckpointManager("gs://bucket/run-7", max_to_keep=3)
+        for step in range(n_steps):
+            ...train...
+            if step % 100 == 0:
+                mgr.save(step, app_state)          # or mgr.async_save
+        # resume later, possibly on a different pod shape:
+        step = CheckpointManager("gs://bucket/run-7").restore(app_state)
+    """
+
+    def __init__(
+        self,
+        base_path: str,
+        max_to_keep: Optional[int] = None,
+        coord: Optional[Coordinator] = None,
+    ) -> None:
+        if max_to_keep is not None and max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self.base_path = base_path
+        self.max_to_keep = max_to_keep
+        self._coord = coord
+
+    # ------------------------------------------------------------- steps
+
+    def _list_steps(self, storage: Any) -> List[int]:
+        markers = asyncio.run(storage.list_prefix(_STEP_PREFIX))
+        if markers is None:
+            raise RuntimeError(
+                f"The storage backend for {self.base_path} cannot "
+                f"enumerate objects; CheckpointManager requires a backend "
+                f"with list_prefix support."
+            )
+        steps = []
+        for m in markers:
+            tail = m[len(_STEP_PREFIX):]
+            try:
+                steps.append(int(tail))
+            except ValueError:
+                logger.warning(f"Ignoring malformed step marker: {m}")
+        return sorted(steps)
+
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending (storage-only; collective-free)."""
+        storage = url_to_storage_plugin(self.base_path)
+        try:
+            return self._list_steps(storage)
+        finally:
+            storage.close()
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -------------------------------------------------------------- save
+
+    def save(
+        self,
+        step: int,
+        app_state: AppState,
+        replicated: Optional[List[str]] = None,
+        compression: Optional[str] = None,
+    ) -> Snapshot:
+        """Take a snapshot for ``step``; commit its marker; prune."""
+        coordinator = get_coordinator(self._coord)
+        snapshot = Snapshot.take(
+            _step_dir(self.base_path, step),
+            app_state,
+            coord=coordinator,
+            replicated=replicated,
+            compression=compression,
+        )
+        self._finalize(step, coordinator)
+        return snapshot
+
+    def async_save(
+        self,
+        step: int,
+        app_state: AppState,
+        replicated: Optional[List[str]] = None,
+        compression: Optional[str] = None,
+        stage: str = "auto",
+    ) -> "PendingManagedSnapshot":
+        """Async take for ``step``; the returned handle's ``wait()``
+        finalizes the marker and pruning after the drain commits —
+        dropping the handle without waiting leaves the step invisible
+        (no marker) and unpruned."""
+        coordinator = get_coordinator(self._coord)
+        pending = Snapshot.async_take(
+            _step_dir(self.base_path, step),
+            app_state,
+            coord=coordinator,
+            replicated=replicated,
+            compression=compression,
+            stage=stage,
+        )
+        return PendingManagedSnapshot(self, step, pending, coordinator)
+
+    def _finalize(self, step: int, coordinator: Coordinator) -> None:
+        # Marker-write + prune on rank 0 only; the trailing barrier keeps
+        # ranks from racing ahead (e.g. immediately resolving latest)
+        # before the marker exists.
+        if coordinator.get_rank() == 0:
+            storage = url_to_storage_plugin(self.base_path)
+            try:
+                marker = IOReq(path=f"{_STEP_PREFIX}{step}")
+                marker.buf.write(_step_dir(self.base_path, step).encode())
+                asyncio.run(storage.write(marker))
+                if self.max_to_keep is not None:
+                    self._prune(storage)
+            finally:
+                storage.close()
+        coordinator.barrier()
+
+    def _prune(self, storage: Any) -> None:
+        steps = self._list_steps(storage)
+        for step in steps[: -self.max_to_keep]:
+            # Marker first: once it is gone, no reader resolves this
+            # step, and the payload delete can proceed (or be re-done by
+            # a later prune/sweep if interrupted).
+            try:
+                asyncio.run(storage.delete(f"{_STEP_PREFIX}{step}"))
+            except Exception as e:
+                if not is_not_found_error(e):
+                    logger.warning(
+                        f"Could not remove step marker {step}: {e!r}"
+                    )
+                    continue
+            try:
+                Snapshot(_step_dir(self.base_path, step)).delete(sweep=True)
+            except Exception as e:
+                logger.warning(
+                    f"Pruning step {step} failed ({e!r}); orphans remain "
+                    f"under {_step_dir(self.base_path, step)}"
+                )
+
+    # ------------------------------------------------------------ restore
+
+    def restore(
+        self,
+        app_state: AppState,
+        step: Optional[int] = None,
+        paths: Optional[List[str]] = None,
+    ) -> int:
+        """Restore ``app_state`` from ``step`` (default: latest);
+        returns the step restored. Latest-resolution happens on rank 0
+        and is broadcast, so a racing prune cannot split ranks."""
+        coordinator = get_coordinator(self._coord)
+        if step is None:
+            chosen = (
+                self.latest_step() if coordinator.get_rank() == 0 else None
+            )
+            step = coordinator.broadcast_object(chosen, src=0)
+            if step is None:
+                raise FileNotFoundError(
+                    f"No committed checkpoints under {self.base_path} "
+                    f"(no {_STEP_PREFIX}* markers)."
+                )
+        Snapshot(_step_dir(self.base_path, step)).restore(
+            app_state, coord=coordinator, paths=paths
+        )
+        return step
+
+
+class PendingManagedSnapshot:
+    """Handle for :meth:`CheckpointManager.async_save`."""
+
+    def __init__(
+        self,
+        manager: CheckpointManager,
+        step: int,
+        pending: PendingSnapshot,
+        coordinator: Coordinator,
+    ) -> None:
+        self._manager = manager
+        self._step = step
+        self._pending = pending
+        self._coordinator = coordinator
+        self._finalized = False
+
+    def done(self) -> bool:
+        return self._pending.done()
+
+    def wait(self) -> Snapshot:
+        snapshot = self._pending.wait()
+        if not self._finalized:
+            # Flag AFTER success: a transient marker-write failure must
+            # stay retriable on the next wait(), not silently skip the
+            # step's commit.
+            self._manager._finalize(self._step, self._coordinator)
+            self._finalized = True
+        return snapshot
