@@ -1,0 +1,65 @@
+"""Checkpoint lifecycle demo: CheckpointManager over a training loop.
+
+Shows the layer above take/restore that real training jobs need (the
+reference leaves all of this to users; reference analog: none):
+step-indexed async saves with sub-second stall, retention pruning, and
+crash-resume from the latest COMMITTED step. Run:
+
+    python examples/checkpoint_manager_example.py
+"""
+
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchsnapshot_tpu import CheckpointManager, StateDict
+
+
+def train_step(w, lr=0.1):
+    # Toy quadratic: minimize ||w - target||^2.
+    target = jnp.arange(w.shape[0], dtype=w.dtype)
+    grad = 2 * (w - target)
+    return w - lr * grad
+
+
+def main() -> None:
+    base = tempfile.mkdtemp(prefix="tpusnapshot-mgr-") + "/run"
+    mgr = CheckpointManager(base, max_to_keep=2)
+
+    step_fn = jax.jit(train_step)
+    w = jnp.zeros((1024,), dtype=jnp.float32)
+    state = StateDict(w=w, step=0)
+
+    pending = None
+    for step in range(30):
+        state["w"] = step_fn(state["w"])
+        state["step"] = step
+        if step % 10 == 0:
+            if pending is not None:
+                pending.wait()
+            pending = mgr.async_save(step, {"train": state})
+            print(f"step {step:3d}: async save dispatched")
+    if pending is not None:
+        pending.wait()
+
+    print(f"committed steps (max_to_keep=2): {mgr.all_steps()}")
+
+    # Simulate a crash + resume in a fresh process: a new manager over
+    # the same base path resumes from the latest committed step.
+    resumed = StateDict(w=jnp.zeros((1024,), dtype=jnp.float32), step=-1)
+    restored_step = CheckpointManager(base).restore({"train": resumed})
+    print(f"resumed from step {restored_step}")
+
+    # Continue training from the restored state; loss keeps decreasing.
+    target = np.arange(1024, dtype=np.float32)
+    before = float(np.sum((np.asarray(resumed["w"]) - target) ** 2))
+    resumed["w"] = step_fn(resumed["w"])
+    after = float(np.sum((np.asarray(resumed["w"]) - target) ** 2))
+    assert after < before
+    print(f"OK: resumed training continues (loss {before:.3f} -> {after:.3f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,33 @@ def test_multi_rank_save_restore(tmp_path, monkeypatch):
 def test_max_to_keep_validation(tmp_path):
     with pytest.raises(ValueError, match="max_to_keep"):
         CheckpointManager(str(tmp_path), max_to_keep=0)
+
+
+def test_interrupted_prune_retried_by_next_prune(tmp_path, monkeypatch):
+    """A prune killed between marker delete and payload delete must not
+    leak the step's payloads forever: the tombstone re-drives it on the
+    next prune (code-review r3)."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = tmp_path / "run"
+    mgr = CheckpointManager(str(base), max_to_keep=2)
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+
+    # Simulate the interrupted prune of step 0: marker gone, tombstone
+    # present, payloads still on disk.
+    os.remove(base / ".steps" / "0")
+    (base / ".pruning").mkdir(exist_ok=True)
+    (base / ".pruning" / "0").write_bytes(b"1")
+    assert (base / "step-0" / ".snapshot_metadata").exists()
+    assert mgr.all_steps() == [1]  # step 0 already unresolvable
+
+    # The next retention-triggering save retries the interrupted prune.
+    mgr.save(2, _state(2))
+    leftovers = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(base / "step-0")
+        for f in fs
+    ]
+    assert leftovers == []
+    assert not (base / ".pruning" / "0").exists()
+    assert mgr.all_steps() == [1, 2]

@@ -41,6 +41,7 @@ from .storage_plugin import url_to_storage_plugin
 logger = logging.getLogger(__name__)
 
 _STEP_PREFIX = ".steps/"
+_PRUNING_PREFIX = ".pruning/"
 
 
 def _step_dir(base_path: str, step: int) -> str:
@@ -165,25 +166,38 @@ class CheckpointManager:
         coordinator.barrier()
 
     def _prune(self, storage: Any) -> None:
+        # Two-phase with a tombstone, so an interrupted prune is
+        # re-driven by the NEXT prune instead of leaking the step's
+        # payloads forever (markers alone cannot re-find a step whose
+        # marker was already deleted):
+        #   1. write .pruning/<step> tombstone
+        #   2. delete the .steps/<step> marker (step now unresolvable)
+        #   3. delete the step's payloads
+        #   4. delete the tombstone
         steps = self._list_steps(storage)
-        for step in steps[: -self.max_to_keep]:
-            # Marker first: once it is gone, no reader resolves this
-            # step, and the payload delete can proceed (or be re-done by
-            # a later prune/sweep if interrupted).
+        doomed = steps[: -self.max_to_keep]
+        leftovers = asyncio.run(storage.list_prefix(_PRUNING_PREFIX)) or []
+        for t in leftovers:
             try:
-                asyncio.run(storage.delete(f"{_STEP_PREFIX}{step}"))
-            except Exception as e:
-                if not is_not_found_error(e):
-                    logger.warning(
-                        f"Could not remove step marker {step}: {e!r}"
-                    )
-                    continue
+                doomed.append(int(t[len(_PRUNING_PREFIX):]))
+            except ValueError:
+                logger.warning(f"Ignoring malformed prune tombstone: {t}")
+        for step in sorted(set(doomed)):
             try:
+                tomb = IOReq(path=f"{_PRUNING_PREFIX}{step}")
+                tomb.buf.write(b"1")
+                asyncio.run(storage.write(tomb))
+                try:
+                    asyncio.run(storage.delete(f"{_STEP_PREFIX}{step}"))
+                except Exception as e:
+                    if not is_not_found_error(e):
+                        raise
                 Snapshot(_step_dir(self.base_path, step)).delete(sweep=True)
+                asyncio.run(storage.delete(f"{_PRUNING_PREFIX}{step}"))
             except Exception as e:
                 logger.warning(
-                    f"Pruning step {step} failed ({e!r}); orphans remain "
-                    f"under {_step_dir(self.base_path, step)}"
+                    f"Pruning step {step} failed ({e!r}); its tombstone "
+                    f"remains and the next prune retries it."
                 )
 
     # ------------------------------------------------------------ restore

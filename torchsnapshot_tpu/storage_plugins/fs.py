@@ -74,11 +74,20 @@ class FSStoragePlugin(StoragePlugin):
         # paths. Walk only the plugin root — never its parent — so a
         # sweep can only ever see this snapshot's own objects (walking
         # dirname(root) for prefix="" would enumerate, and let sweep
-        # delete, sibling snapshots).
+        # delete, sibling snapshots). The walk starts at the deepest
+        # directory the prefix names: listing ".steps/" over a base
+        # holding thousands of payload files must cost O(markers), not
+        # O(all objects) — CheckpointManager lists markers on every
+        # save/restore.
         found = []
-        if not os.path.isdir(self.root):
+        walk_dir = self.root
+        rel_dir = ""
+        if "/" in prefix:
+            rel_dir = prefix.rsplit("/", 1)[0]
+            walk_dir = os.path.join(self.root, rel_dir)
+        if not os.path.isdir(walk_dir):
             return found
-        for dirpath, _, filenames in os.walk(self.root):
+        for dirpath, _, filenames in os.walk(walk_dir):
             for name in filenames:
                 rel = os.path.relpath(os.path.join(dirpath, name), self.root)
                 if rel.startswith(prefix):
