@@ -2,6 +2,7 @@
 async finalization, multi-rank agreement (beyond reference parity)."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -150,3 +151,45 @@ def test_interrupted_prune_retried_by_next_prune(tmp_path, monkeypatch):
     assert leftovers == []
     assert not (base / ".pruning" / "0").exists()
     assert mgr.all_steps() == [1, 2]
+
+
+def test_tombstone_survives_age_guarded_sweep(tmp_path):
+    """Under the DEFAULT sweep age guard (1h), a tombstone retry whose
+    payloads are young gets spared — the tombstone must survive so a
+    later prune retries, instead of leaking the step forever
+    (code-review r3 follow-up)."""
+    base = tmp_path / "run"
+    mgr = CheckpointManager(str(base), max_to_keep=2)
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+
+    # Interrupted prune of step 0: marker AND metadata gone (the
+    # interrupted Snapshot.delete removed metadata first), payloads
+    # remain and are minutes old.
+    os.remove(base / ".steps" / "0")
+    os.remove(base / "step-0" / ".snapshot_metadata")
+    (base / ".pruning").mkdir(exist_ok=True)
+    (base / ".pruning" / "0").write_bytes(b"1")
+
+    # Default age guard active: retry spares the young payloads but the
+    # tombstone must survive.
+    mgr.save(2, _state(2))
+    assert (base / ".pruning" / "0").exists()
+    payloads = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(base / "step-0")
+        for f in fs
+    ]
+    assert payloads  # spared, not leaked-and-forgotten
+
+    # Once the payloads age out, the next prune clears them + tombstone.
+    old = time.time() - 7200
+    for p in payloads:
+        os.utime(p, (old, old))
+    mgr.save(3, _state(3))
+    assert not (base / ".pruning" / "0").exists()
+    assert [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(base / "step-0")
+        for f in fs
+    ] == []

@@ -193,7 +193,24 @@ class CheckpointManager:
                     if not is_not_found_error(e):
                         raise
                 Snapshot(_step_dir(self.base_path, step)).delete(sweep=True)
-                asyncio.run(storage.delete(f"{_PRUNING_PREFIX}{step}"))
+                # The tombstone clears only once the step prefix is
+                # verifiably empty: a retry sweep may SPARE young
+                # unreferenced payloads under TPUSNAPSHOT_SWEEP_MIN_AGE_S
+                # (they look like an in-progress take to the guard) and
+                # still return success — dropping the tombstone then
+                # would make the leak permanent. Kept tombstones retry on
+                # later prunes, succeeding once the guard ages out.
+                remaining = asyncio.run(
+                    storage.list_prefix(f"step-{step}/")
+                )
+                if remaining:
+                    logger.info(
+                        f"Prune of step {step}: {len(remaining)} "
+                        f"object(s) spared by the sweep age guard; "
+                        f"keeping its tombstone for a later retry."
+                    )
+                else:
+                    asyncio.run(storage.delete(f"{_PRUNING_PREFIX}{step}"))
             except Exception as e:
                 logger.warning(
                     f"Pruning step {step} failed ({e!r}); its tombstone "
