@@ -193,3 +193,20 @@ def test_tombstone_survives_age_guarded_sweep(tmp_path):
         for dp, _, fs in os.walk(base / "step-0")
         for f in fs
     ] == []
+
+
+def test_keep_period_archives_periodic_steps(tmp_path, monkeypatch):
+    """keep_period steps are archived: never counted against max_to_keep,
+    never pruned — a rolling recent window plus periodic keepers."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    mgr = CheckpointManager(
+        str(tmp_path / "run"), max_to_keep=2, keep_period=100
+    )
+    for step in (0, 50, 100, 150, 175, 200, 225, 250):
+        mgr.save(step, _state(step))
+    # Archived: 0, 100, 200 (multiples of 100). Rolling window: the two
+    # newest non-archived steps (225, 250).
+    assert mgr.all_steps() == [0, 100, 200, 225, 250]
+    target = _target()
+    assert mgr.restore(target, step=100) == 100
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 100.0)

@@ -66,12 +66,21 @@ class CheckpointManager:
         self,
         base_path: str,
         max_to_keep: Optional[int] = None,
+        keep_period: Optional[int] = None,
         coord: Optional[Coordinator] = None,
     ) -> None:
+        """``max_to_keep`` bounds retained checkpoints; ``keep_period``
+        additionally ARCHIVES every checkpoint whose step is a multiple
+        of it — archived steps never count against ``max_to_keep`` and
+        are never pruned (the orbax retention contract: a rolling recent
+        window plus periodic keepers for post-hoc evaluation)."""
         if max_to_keep is not None and max_to_keep < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        if keep_period is not None and keep_period < 1:
+            raise ValueError(f"keep_period must be >= 1, got {keep_period}")
         self.base_path = base_path
         self.max_to_keep = max_to_keep
+        self.keep_period = keep_period
         self._coord = coord
 
     # ------------------------------------------------------------- steps
@@ -175,6 +184,8 @@ class CheckpointManager:
         #   3. delete the step's payloads
         #   4. delete the tombstone
         steps = self._list_steps(storage)
+        if self.keep_period is not None:
+            steps = [s for s in steps if s % self.keep_period != 0]
         doomed = steps[: -self.max_to_keep]
         leftovers = asyncio.run(storage.list_prefix(_PRUNING_PREFIX)) or []
         for t in leftovers:
