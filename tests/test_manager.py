@@ -210,3 +210,47 @@ def test_keep_period_archives_periodic_steps(tmp_path, monkeypatch):
     target = _target()
     assert mgr.restore(target, step=100) == 100
     np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 100.0)
+
+
+def test_manager_on_fake_gcs(monkeypatch):
+    """The lifecycle layer over the north-star gs:// backend (fake
+    client): markers, retention pruning (incl. composite .part orphans),
+    and latest-resolution all ride the same StoragePlugin surface."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_cloud_plugins import _FakeGCSClient
+
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    client = _FakeGCSClient()
+
+    def to_plugin(url):
+        from torchsnapshot_tpu.io_types import RetryingStoragePlugin
+
+        root = url[len("gs://"):]
+        return RetryingStoragePlugin(
+            GCSStoragePlugin(root=root, client=client)
+        )
+
+    monkeypatch.setattr(sp, "url_to_storage_plugin", to_plugin)
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin", to_plugin
+    )
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.manager.url_to_storage_plugin", to_plugin
+    )
+
+    mgr = CheckpointManager("gs://bucket/run", max_to_keep=1)
+    for step in (1, 2):
+        mgr.save(step, _state(step))
+    assert mgr.all_steps() == [2]
+    # Step 1's objects are gone from the bucket; step 2's remain.
+    assert not [k for k in client.store if k.startswith("run/step-1/")]
+    assert [k for k in client.store if k.startswith("run/step-2/")]
+
+    target = _target()
+    assert mgr.restore(target) == 2
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 2.0)
