@@ -254,3 +254,54 @@ def test_manager_on_fake_gcs(monkeypatch):
     target = _target()
     assert mgr.restore(target) == 2
     np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 2.0)
+
+
+def test_lifecycle_stress_with_random_interruptions(tmp_path, monkeypatch):
+    """Seeded chaos over the manager's invariants: random saves with
+    randomly injected crash artifacts (uncommitted dirs, orphaned
+    tombstones, stray markers deleted). Invariants after every event:
+    all_steps() only lists steps whose snapshots actually restore, and
+    restore(step=None) always succeeds when any step is committed."""
+    import random
+
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    rng = random.Random(7)
+    base = tmp_path / "run"
+    mgr = CheckpointManager(str(base), max_to_keep=3)
+    committed = set()
+
+    for step in range(30):
+        event = rng.random()
+        if event < 0.6:
+            mgr.save(step, _state(step))
+            committed.add(step)
+            committed = set(sorted(committed)[-3:])
+        elif event < 0.75:
+            # Crashed take: payload dir without marker.
+            Snapshot.take(str(base / f"step-{step}"), _state(step))
+            os.remove(base / f"step-{step}" / ".snapshot_metadata")
+        elif event < 0.9 and committed:
+            # Interrupted prune of the oldest committed step.
+            victim = min(committed)
+            os.remove(base / ".steps" / str(victim))
+            (base / ".pruning").mkdir(exist_ok=True)
+            (base / ".pruning" / str(victim)).write_bytes(b"1")
+            committed.discard(victim)
+        # else: plain training step, no checkpoint event.
+
+        steps = mgr.all_steps()
+        assert steps == sorted(committed), (step, steps, committed)
+        for s in steps:
+            # Every listed step must be a restorable snapshot.
+            target = _target()
+            assert mgr.restore(target, step=s) == s
+            np.testing.assert_array_equal(
+                np.asarray(target["s"]["w"]), float(s)
+            )
+        if steps:
+            assert mgr.restore(_target()) == max(steps)
+
+    # Final cleanliness: one more save drives any leftover tombstones.
+    mgr.save(99, _state(99))
+    if (base / ".pruning").exists():
+        assert list((base / ".pruning").glob("*")) == []
