@@ -305,3 +305,22 @@ def test_lifecycle_stress_with_random_interruptions(tmp_path, monkeypatch):
     mgr.save(99, _state(99))
     if (base / ".pruning").exists():
         assert list((base / ".pruning").glob("*")) == []
+
+
+def test_inspect_cli_steps(tmp_path, capsys):
+    from torchsnapshot_tpu.inspect import main
+
+    base = str(tmp_path / "run")
+    mgr = CheckpointManager(base)
+    mgr.save(3, _state(3))
+    mgr.save(7, _state(7))
+    assert main([base, "--steps"]) == 0
+    assert capsys.readouterr().out.split() == ["3", "7"]
+    assert main([str(tmp_path / "empty"), "--steps"]) == 1
+
+
+def test_inspect_cli_steps_mutually_exclusive(tmp_path):
+    from torchsnapshot_tpu.inspect import main
+
+    with pytest.raises(SystemExit):
+        main([str(tmp_path), "--steps", "--delete"])

@@ -86,18 +86,36 @@ def main(argv=None) -> int:
         "format at DEST (torch_save payloads + YAML metadata; sharded "
         "arrays assemble dense) — the reverse-migration path",
     )
+    parser.add_argument(
+        "--steps",
+        action="store_true",
+        help="treat PATH as a CheckpointManager base dir and list its "
+        "committed steps",
+    )
     args = parser.parse_args(argv)
 
     exclusive = [
         bool(args.verify),
         bool(args.delete or args.sweep),
         bool(args.convert_back),
+        bool(args.steps),
     ]
     if sum(exclusive) > 1:
         parser.error(
-            "--verify, --delete/--sweep, and --convert-back are mutually "
-            "exclusive; run them in separate invocations"
+            "--verify, --delete/--sweep, --convert-back, and --steps are "
+            "mutually exclusive; run them in separate invocations"
         )
+    if args.steps:
+        from .manager import CheckpointManager
+
+        steps = CheckpointManager(args.path).all_steps()
+        if not steps:
+            # stderr: stdout is the machine-readable step list here.
+            print("no committed steps", file=sys.stderr)
+            return 1
+        for step in steps:
+            print(step)
+        return 0
     if args.convert_back:
         from .interop.reference_writer import convert_back
 
