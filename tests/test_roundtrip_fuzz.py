@@ -1,0 +1,145 @@
+"""Seeded whole-stack round-trip fuzz: random nested app states through
+take -> restore must come back bit-exact. Exercises flatten/manifest/
+io_preparer/scheduler/storage jointly on shapes no hand-written test
+enumerates (the resharding fuzz covers mesh geometry; this covers
+container/dtype geometry)."""
+
+import random
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot
+
+_DTYPES = [
+    np.float32,
+    np.float16,
+    np.int32,
+    np.int8,
+    np.uint16,
+    np.bool_,
+]
+
+
+def _rand_leaf(rng: random.Random):
+    kind = rng.random()
+    if kind < 0.45:
+        dtype = rng.choice(_DTYPES)
+        ndim = rng.randint(0, 3)
+        shape = tuple(rng.randint(1, 5) for _ in range(ndim))
+        n = int(np.prod(shape)) if shape else 1
+        if dtype == np.bool_:
+            arr = (np.arange(n) % 2 == 0).reshape(shape)
+        else:
+            arr = (np.arange(n) % 120).astype(dtype).reshape(shape)
+        return jnp.asarray(arr) if rng.random() < 0.5 else arr
+    if kind < 0.55:
+        arr = np.arange(8, dtype=np.float32).view(np.uint16)[:4]
+        return arr.copy()  # odd strides/dtype views normalized to copy
+    if kind < 0.7:
+        return rng.randint(-(10**12), 10**12)  # primitive int
+    if kind < 0.8:
+        return rng.choice([True, False, None, 2.5, -0.0, "häłlo/☃"])
+    if kind < 0.9:
+        return {"frozen", "set", rng.randint(0, 9)}  # arbitrary object
+    return bytes([rng.randint(0, 255) for _ in range(rng.randint(0, 9))])
+
+
+def _rand_tree(rng: random.Random, depth: int):
+    if depth == 0 or rng.random() < 0.3:
+        return _rand_leaf(rng)
+    kind = rng.random()
+    n = rng.randint(0, 3)
+    if kind < 0.4:
+        return {f"k{i}": _rand_tree(rng, depth - 1) for i in range(n)}
+    if kind < 0.6:
+        return OrderedDict(
+            (f"o{i}", _rand_tree(rng, depth - 1)) for i in range(n)
+        )
+    if kind < 0.8:
+        return [_rand_tree(rng, depth - 1) for _ in range(n)]
+    return tuple(_rand_tree(rng, depth - 1) for _ in range(n))
+
+
+def _assert_tree_equal(a, b, path="root"):
+    assert type(a) is type(b) or (
+        # jax in, numpy/jax out: compare as arrays below.
+        hasattr(a, "shape") and hasattr(b, "shape")
+    ), f"{path}: {type(a)} vs {type(b)}"
+    if isinstance(a, (dict, OrderedDict)):
+        assert list(a.keys()) == list(b.keys()), path
+        for k in a:
+            _assert_tree_equal(a[k], b[k], f"{path}/{k}")
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_tree_equal(x, y, f"{path}/{i}")
+    elif hasattr(a, "shape"):
+        an, bn = np.asarray(a), np.asarray(b)
+        assert an.dtype == bn.dtype, f"{path}: {an.dtype} vs {bn.dtype}"
+        assert an.shape == bn.shape, f"{path}: {an.shape} vs {bn.shape}"
+        np.testing.assert_array_equal(an, bn, err_msg=path)
+    else:
+        assert a == b, f"{path}: {a!r} vs {b!r}"
+        # -0.0 vs 0.0 and bool-vs-int distinctions matter for resume.
+        if isinstance(a, float):
+            assert np.signbit(a) == np.signbit(b), path
+        assert type(a) is type(b), f"{path}: {type(a)} vs {type(b)}"
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_tree_roundtrip(seed, tmp_path):
+    rng = random.Random(seed)
+    tree = {"root": _rand_tree(rng, depth=3)}
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder(tree)})
+
+    # The documented restore contract: a holder with the SAME structure
+    # but zeroed/SENTINEL leaves — a restore that silently skipped any
+    # leaf must fail the comparison, not pass it vacuously.
+    def zero_like(x):
+        if hasattr(x, "shape"):
+            arr = np.asarray(x)
+            return np.zeros(arr.shape, arr.dtype)
+        if isinstance(x, bool):
+            return not x
+        if isinstance(x, int):
+            return x - 12345
+        if isinstance(x, float):
+            return 123.456
+        if isinstance(x, str):
+            return "SENTINEL"
+        if isinstance(x, bytes):
+            return b"SENTINEL"
+        if x is None:
+            return None  # no distinguishable sentinel
+        # A set: an object LEAF (a list sentinel would flatten as a
+        # container and diverge the template structure).
+        return {"WRONG_OBJECT"}
+
+    def map_tree(t):
+        if isinstance(t, (dict, OrderedDict)):
+            return type(t)((k, map_tree(v)) for k, v in t.items())
+        if isinstance(t, list):
+            return [map_tree(v) for v in t]
+        if isinstance(t, tuple):
+            return tuple(map_tree(v) for v in t)
+        return zero_like(t)
+
+    target = _Holder({"root": map_tree(tree["root"])})
+    Snapshot(path).restore({"m": target})
+    _assert_tree_equal(tree, target.sd, "m")
