@@ -275,8 +275,8 @@ class PendingManagedSnapshot:
     def done(self) -> bool:
         return self._pending.done()
 
-    def wait(self) -> Snapshot:
-        snapshot = self._pending.wait()
+    def wait(self, timeout_s: float = 1800.0) -> Snapshot:
+        snapshot = self._pending.wait(timeout_s=timeout_s)
         if not self._finalized:
             # Flag AFTER success: a transient marker-write failure must
             # stay retriable on the next wait(), not silently skip the
