@@ -23,11 +23,14 @@ from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
 
 
 class _FakeNotFound(Exception):
-    """Shaped like google.api_core.exceptions.NotFound (code=404). The
-    real client never raises KeyError — fakes must speak the same
-    dialect the structural not-found classifier understands."""
+    """Shaped like google.api_core.exceptions.NotFound (code=404 plus an
+    ``errors`` attribute — the classifier requires HTTP-library shape,
+    not a bare overloaded ``code``). The real client never raises
+    KeyError — fakes must speak the same dialect the structural
+    not-found classifier understands."""
 
     code = 404
+    errors = ()
 
 
 class _FakeBlob:

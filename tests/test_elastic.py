@@ -99,7 +99,7 @@ class _StubCoordinator:
     def get_world_size(self):
         return self._world
 
-    def barrier(self):
+    def barrier(self, timeout_s=None):
         pass
 
     def all_gather_object(self, obj):

@@ -443,11 +443,14 @@ def test_verify_length_only_probes_for_unchecksummed_large_objects(
 
 
 class _Range416(Exception):
-    """Shaped like google.api_core RequestRangeNotSatisfiable (code=416)."""
+    """Shaped like google.api_core RequestRangeNotSatisfiable (code=416
+    plus ``errors`` — the classifier requires HTTP-library shape, not a
+    bare overloaded ``code``)."""
 
     def __init__(self):
         super().__init__("416 requested range not satisfiable")
         self.code = 416
+        self.errors = ()
 
 
 class _RangeStrict416Storage:
@@ -592,3 +595,19 @@ def test_range_not_satisfiable_classifier():
         RuntimeError("proxy error: 416 Range Not Satisfiable")
     )
     assert not is_range_not_satisfiable_error(FileNotFoundError("x"))
+    # `code` is an overloaded attribute (grpc status enums, library
+    # error codes): code==416 without any HTTP-library shape must not
+    # classify (ADVICE r3) — otherwise the retry layer treats a
+    # retryable failure as deterministic and gives up.
+    class GrpcStatusLookalike(Exception):
+        code = 416
+
+    GrpcStatusLookalike.__module__ = "some.rpc.lib"
+    assert not is_range_not_satisfiable_error(GrpcStatusLookalike())
+
+    class HttpShapedCode(Exception):  # google.api_core carries .errors
+        code = 416
+        errors = ()
+
+    HttpShapedCode.__module__ = "some.rpc.lib"
+    assert is_range_not_satisfiable_error(HttpShapedCode())

@@ -324,3 +324,52 @@ def test_inspect_cli_steps_mutually_exclusive(tmp_path):
 
     with pytest.raises(SystemExit):
         main([str(tmp_path), "--steps", "--delete"])
+
+
+def test_finalize_marker_before_barrier_prune_after(tmp_path, monkeypatch):
+    """_finalize ordering (ADVICE r3): the step marker must be committed
+    before the barrier releases non-zero ranks, and retention pruning —
+    whose cloud-backend latency can approach the barrier timeout — must
+    run after the barrier so it can never stall the other ranks."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = tmp_path / "run"
+    events = []
+
+    from torchsnapshot_tpu.coord import NoOpCoordinator
+
+    class RecordingCoord(NoOpCoordinator):
+        def barrier(self, timeout_s=None):
+            marker_dir = base / ".steps"
+            markers = (
+                sorted(p.name for p in marker_dir.iterdir())
+                if marker_dir.exists()
+                else []
+            )
+            events.append(("barrier", markers))
+
+    orig_prune = CheckpointManager._prune
+
+    def recording_prune(self, storage):
+        events.append(("prune", None))
+        return orig_prune(self, storage)
+
+    monkeypatch.setattr(CheckpointManager, "_prune", recording_prune)
+
+    mgr = CheckpointManager(str(base), max_to_keep=1, coord=RecordingCoord())
+    for step in range(2):
+        mgr.save(step, {"s": StateDict(x=np.ones((2,)))})
+
+    barriers = [e for e in events if e[0] == "barrier"]
+    prunes = [e for e in events if e[0] == "prune"]
+    assert len(prunes) == 2
+    # Finalize barriers must observe the just-written marker (take()'s
+    # own commit barriers run before any marker exists).
+    assert any(e[1] == ["0"] for e in barriers)
+    assert any("1" in e[1] for e in barriers)
+    # Ordering within the last finalize: marker-bearing barrier precedes
+    # the prune.
+    last_prune_idx = max(i for i, e in enumerate(events) if e[0] == "prune")
+    prior_barriers = [
+        e for e in events[:last_prune_idx] if e[0] == "barrier"
+    ]
+    assert prior_barriers and "1" in prior_barriers[-1][1]

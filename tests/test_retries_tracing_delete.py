@@ -167,15 +167,26 @@ def test_structured_not_found_codes_classified():
         ClientError({"ResponseMetadata": {"HTTPStatusCode": 500}})
     )
 
-    class ApiError(Exception):
+    class ApiError(Exception):  # google.api_core shape: code + errors
         code = 404
+        errors = ()
 
     assert is_not_found_error(ApiError("gone"))
 
     class ApiError500(Exception):
         code = 500
+        errors = ()
 
     assert not is_not_found_error(ApiError500("boom"))
+
+    # `code` is overloaded (grpc status enums, library error codes): a
+    # bare code==404 with no HTTP-library shape must NOT classify
+    # (ADVICE r3) — else the retry layer gives up on retryable failures.
+    class GrpcLookalike(Exception):
+        code = 404
+
+    GrpcLookalike.__module__ = "some.rpc.lib"
+    assert not is_not_found_error(GrpcLookalike("status 404"))
 
 
 def test_tracing_records_snapshot_spans(tmp_path):

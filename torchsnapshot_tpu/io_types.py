@@ -27,6 +27,33 @@ BufferType = Union[bytes, bytearray, memoryview]
 logger = logging.getLogger(__name__)
 
 
+def _code_attr_http_status(exc: BaseException) -> Optional[int]:
+    """The exception's ``.code`` as an int — but only when the exception
+    plausibly comes from an HTTP client library. ``code`` is an
+    overloaded attribute name (grpc status enums, library-specific error
+    codes), so a bare integer match is not evidence of an HTTP status
+    (ADVICE r3): misclassifying a retryable failure as a deterministic
+    404/416 makes the retry layer give up and pollers misread errors.
+    The gate: an ``errors``/``response`` attribute (google.api_core
+    carries both) or an HTTP-flavored defining module."""
+    code = getattr(exc, "code", None)
+    if code is None:
+        return None
+    if not (
+        hasattr(exc, "errors")
+        or getattr(exc, "response", None) is not None
+        or any(
+            tok in type(exc).__module__
+            for tok in ("google", "http", "urllib", "requests", "aiohttp")
+        )
+    ):
+        return None
+    try:
+        return int(code)
+    except (TypeError, ValueError):
+        return None
+
+
 def is_not_found_error(exc: BaseException) -> bool:
     """Whether a storage failure means "object does not exist".
 
@@ -53,12 +80,8 @@ def is_not_found_error(exc: BaseException) -> bool:
     # http.HTTPStatus); botocore ClientError carries
     # `.response["ResponseMetadata"]["HTTPStatusCode"]` and
     # `.response["Error"]["Code"]`.
-    code = getattr(exc, "code", None)
-    try:
-        if code is not None and int(code) == 404:
-            return True
-    except (TypeError, ValueError):
-        pass
+    if _code_attr_http_status(exc) == 404:
+        return True
     response = getattr(exc, "response", None)
     if isinstance(response, dict):
         error_code = response.get("Error", {}).get("Code")
@@ -92,19 +115,13 @@ def is_range_not_satisfiable_error(exc: BaseException) -> bool:
             "InvalidRange",
         ):
             return True
-    code = getattr(exc, "code", None)
-    try:
-        if code is not None and int(code) == 416:
-            return True
-    except (TypeError, ValueError):
-        pass
     response = getattr(exc, "response", None)
     if isinstance(response, dict):
         if response.get("Error", {}).get("Code") in ("416", "InvalidRange"):
             return True
         if response.get("ResponseMetadata", {}).get("HTTPStatusCode") == 416:
             return True
-    return False
+    return _code_attr_http_status(exc) == 416
 
 
 # Storage-op retry policy (beyond reference parity: the reference has no

@@ -32,9 +32,9 @@ import asyncio
 import logging
 from typing import Any, List, Optional
 
-from .coord import Coordinator, get_coordinator
+from .coord import Coordinator, barrier_compat, get_coordinator
 from .io_types import IOReq, is_not_found_error
-from .snapshot import PendingSnapshot, Snapshot
+from .snapshot import _COMPLETION_TIMEOUT_S, PendingSnapshot, Snapshot
 from .stateful import AppState
 from .storage_plugin import url_to_storage_plugin
 
@@ -159,20 +159,35 @@ class CheckpointManager:
         return PendingManagedSnapshot(self, step, pending, coordinator)
 
     def _finalize(self, step: int, coordinator: Coordinator) -> None:
-        # Marker-write + prune on rank 0 only; the trailing barrier keeps
-        # ranks from racing ahead (e.g. immediately resolving latest)
-        # before the marker exists.
-        if coordinator.get_rank() == 0:
-            storage = url_to_storage_plugin(self.base_path)
+        # Marker write (rank 0) is the correctness-bearing, latency-
+        # critical part: do it first, barrier, and only then prune
+        # (ADVICE r3). Pruning a full step over a cloud backend can
+        # itself approach the barrier timeout, and must not stall the
+        # other ranks; the barrier runs in a ``finally`` so a rank-0
+        # marker failure releases them promptly (they observe it as the
+        # step never becoming latest) instead of stranding them in an
+        # opaque store TimeoutError.
+        storage = None
+        try:
             try:
-                marker = IOReq(path=f"{_STEP_PREFIX}{step}")
-                marker.buf.write(_step_dir(self.base_path, step).encode())
-                asyncio.run(storage.write(marker))
-                if self.max_to_keep is not None:
-                    self._prune(storage)
+                if coordinator.get_rank() == 0:
+                    storage = url_to_storage_plugin(self.base_path)
+                    marker = IOReq(path=f"{_STEP_PREFIX}{step}")
+                    marker.buf.write(
+                        _step_dir(self.base_path, step).encode()
+                    )
+                    asyncio.run(storage.write(marker))
             finally:
+                # The marker write above can legitimately outlast the
+                # store's default wait (storage retries + backoff over a
+                # flaky cloud backend), so waiting ranks get the same
+                # long leash as the snapshot commit barrier.
+                barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
+            if storage is not None and self.max_to_keep is not None:
+                self._prune(storage)
+        finally:
+            if storage is not None:
                 storage.close()
-        coordinator.barrier()
 
     def _prune(self, storage: Any) -> None:
         # Two-phase with a tombstone, so an interrupted prune is

@@ -48,7 +48,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import tracing
-from .coord import Coordinator, get_coordinator
+from .coord import Coordinator, barrier_compat, get_coordinator
 from .flatten import flatten, inflate
 from .io_preparer import device_clone_write_reqs, prepare_read, prepare_write
 from .io_types import (
@@ -315,7 +315,12 @@ class Snapshot:
                 )
                 if rank == 0:
                     _write_snapshot_metadata(storage, metadata)
-            coordinator.barrier()
+            # Rank 0 holds this barrier until its metadata write (and, on
+            # the storage route, the O(world) marker collection under
+            # _COMPLETION_TIMEOUT_S) finishes — which can legitimately
+            # exceed the coordinator's default store timeout at scale, so
+            # the barrier must wait at least as long (ADVICE r3).
+            barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
         else:
             # Async take. All *collectives* run in the foreground (they are
             # kilobytes over the KV store); storage writes and the manifest
@@ -1206,9 +1211,22 @@ def _metadata_compress_threshold() -> int:
     # Read per-call (like the sibling commit-route knob): the documented
     # rolling-upgrade workflow sets the env var from training-script
     # setup code, which may run after this module imports.
-    return int(
-        os.environ.get("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD", 1 << 20)
-    )
+    raw = os.environ.get("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD")
+    if raw is None:
+        return 1 << 20
+    try:
+        return int(raw)
+    except ValueError:
+        # A malformed knob must not raise inside _encode_metadata_doc —
+        # that runs during commit, inside a collective, so one rank's
+        # typo would strand every other rank until the coordinator
+        # timeout. Same log-and-default contract as the sibling
+        # _commit_via_storage_threshold knob (ADVICE r3).
+        logger.warning(
+            "Ignoring malformed TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD="
+            f"{raw!r}; using default {1 << 20}"
+        )
+        return 1 << 20
 
 
 def _encode_metadata_doc(doc: str) -> bytes:
