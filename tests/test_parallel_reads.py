@@ -1,0 +1,208 @@
+"""Parallel ranged reads for large objects on restore (VERDICT r3 #2).
+
+A dense ArrayEntry is one storage object of unbounded size; a
+single-stream download caps restore far below the link ceiling on
+object stores. Whole-object reads above a threshold are split into
+concurrent ranged sub-reads reassembled on host — the read-side mirror
+of the GCS composite upload (reference analog: 100 MB download chunks,
+reference torchsnapshot/storage_plugins/gcs.py:55).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+class _RecordingFS(FSStoragePlugin):
+    """Records every read's (path, byte_range)."""
+
+    reads = []  # class-level so monkeypatched constructor calls share it
+
+    async def read(self, io_req):
+        _RecordingFS.reads.append((io_req.path, io_req.byte_range))
+        await super().read(io_req)
+
+
+@pytest.fixture
+def recording_fs(monkeypatch):
+    import torchsnapshot_tpu.snapshot as snap_mod
+
+    _RecordingFS.reads = []
+    monkeypatch.setattr(
+        snap_mod, "url_to_storage_plugin", lambda path: _RecordingFS(path)
+    )
+    return _RecordingFS
+
+
+def _round_trip(tmp_path, arr, monkeypatch, threshold, strict=False):
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", str(threshold))
+    if strict:
+        monkeypatch.setenv("TPUSNAPSHOT_STRICT_INTEGRITY", "1")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    return np.asarray(target["m"].sd["w"])
+
+
+def test_large_dense_read_is_split_and_bit_exact(
+    tmp_path, monkeypatch, recording_fs
+):
+    rng = np.random.default_rng(0)
+    arr = jnp.asarray(rng.standard_normal((64, 64)), dtype=jnp.float32)
+    nbytes = 64 * 64 * 4  # 16 KiB
+    threshold = 4096
+    out = _round_trip(tmp_path, arr, monkeypatch, threshold)
+    np.testing.assert_array_equal(out, np.asarray(arr))
+    ranged = [
+        (p, r) for p, r in recording_fs.reads if r is not None and "/w" in p
+    ]
+    assert len(ranged) == nbytes // threshold  # 4 concurrent sub-reads
+    # Sub-ranges tile the object exactly.
+    spans = sorted(r for _, r in ranged)
+    assert spans[0][0] == 0 and spans[-1][1] == nbytes
+    for (_, a_end), (b_start, _) in zip(spans, spans[1:]):
+        assert a_end == b_start
+
+
+def test_split_read_verifies_checksum_over_assembled_object(
+    tmp_path, monkeypatch, recording_fs
+):
+    """Splitting must stay integrity-preserving: the checksum is checked
+    over the reassembled payload, so mid-object corruption is caught
+    even though each sub-read alone cannot verify anything."""
+    arr = jnp.arange(8192, dtype=jnp.float32)  # 32 KiB
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "4096")
+    monkeypatch.setenv("TPUSNAPSHOT_STRICT_INTEGRITY", "1")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    # Corrupt bytes in the MIDDLE of the object (inside sub-read 3).
+    obj = tmp_path / "snap" / "0" / "m" / "w"
+    raw = bytearray(obj.read_bytes())
+    raw[10000:10004] = b"\xde\xad\xbe\xef"
+    obj.write_bytes(bytes(raw))
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    with pytest.raises(RuntimeError, match="[Cc]hecksum"):
+        Snapshot(path).restore(target)
+
+
+def test_split_read_strict_integrity_round_trip(
+    tmp_path, monkeypatch, recording_fs
+):
+    arr = jnp.arange(4096, dtype=jnp.float32)
+    out = _round_trip(tmp_path, arr, monkeypatch, 1024, strict=True)
+    np.testing.assert_array_equal(out, np.arange(4096, dtype=np.float32))
+
+
+def test_compressed_objects_are_not_split(tmp_path, monkeypatch, recording_fs):
+    """Compressed stored size is not derivable from the manifest shape,
+    so compressed objects read whole regardless of size."""
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "1024")
+    path = str(tmp_path / "snap")
+    arr = jnp.zeros((4096,), dtype=jnp.float32)  # compresses well
+    Snapshot.take(
+        path, {"m": _Holder({"w": arr})}, compression="zlib"
+    )
+    target = {"m": _Holder({"w": jnp.ones_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), 0.0)
+    assert all(
+        r is None for p, r in _RecordingFS.reads if p.endswith("/w")
+    )
+
+
+def test_truncated_object_fails_loudly_through_split_path(
+    tmp_path, monkeypatch, recording_fs
+):
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "1024")
+    path = str(tmp_path / "snap")
+    arr = jnp.arange(2048, dtype=jnp.float32)  # 8 KiB
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    obj = tmp_path / "snap" / "0" / "m" / "w"
+    obj.write_bytes(obj.read_bytes()[:5000])  # truncate mid-object
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    with pytest.raises(Exception):
+        Snapshot(path).restore(target)
+
+
+def test_malformed_threshold_falls_back(monkeypatch):
+    from torchsnapshot_tpu.io_preparer import (
+        _DEFAULT_PARALLEL_READ_THRESHOLD,
+        _parallel_read_threshold,
+    )
+
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "not-a-number")
+    assert _parallel_read_threshold() == _DEFAULT_PARALLEL_READ_THRESHOLD
+
+
+def test_sharded_contiguous_subrange_split(tmp_path, monkeypatch):
+    """A large contiguous ranged read (resharded restore fetching a
+    byte run of a saved chunk) is split the same way, with sub-ranges
+    offset into the stored object."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = Mesh(np.array(devices[:2]), ("x",))
+    arr = jnp.asarray(
+        np.random.default_rng(1).standard_normal((256, 16)), jnp.float32
+    )
+    sharded = jax.device_put(arr, NamedSharding(mesh, P("x", None)))
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": sharded})})
+
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "1024")
+    # Restore onto a single device: one region overlapping each saved
+    # chunk wholly — contiguous ranges of each chunk.
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), arr)
+
+
+def test_split_read_on_fake_gcs(monkeypatch):
+    """The split path over the north-star gs:// backend: ranged
+    sub-reads hit the fake GCS client's download_as_bytes(start, end)
+    surface and reassemble bit-exactly."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_cloud_plugins import _FakeGCSClient
+
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.io_types import RetryingStoragePlugin
+
+    client = _FakeGCSClient()
+
+    def to_plugin(url):
+        root = url[len("gs://"):]
+        return RetryingStoragePlugin(
+            GCSStoragePlugin(root=root, client=client)
+        )
+
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin", to_plugin
+    )
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", "4096")
+    rng = np.random.default_rng(7)
+    arr = jnp.asarray(rng.standard_normal((64, 64)), dtype=jnp.float32)
+    Snapshot.take("gs://bucket/snap", {"m": _Holder({"w": arr})})
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot("gs://bucket/snap").restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), arr)
