@@ -38,6 +38,26 @@ payload — and if it must shrink below the floor (or must cut the restore
 below its 0.5 GiB floor), the JSON carries ``"degraded": true`` so a
 collapsed-tunnel window can never masquerade as a certified number.
 
+**Round-4 additions** (VERDICT r3 #1/#3/#8):
+
+- The restore is re-timed not only on probe disagreement but whenever
+  restore/ceiling misses 0.5 with stable probes (BENCH_r03: a
+  mid-window tunnel collapse that recovers before the trailing probe
+  produced a 14x-slow restore with spread 1.08, certified as healthy);
+  if the ratio still misses after retries the JSON carries
+  ``"restore_uncertified": true`` (which also sets ``degraded``), and
+  every timed restore dumps a per-phase span breakdown
+  (read/consume/assemble) to stderr + the JSON so a tunnel collapse is
+  distinguishable from a code stall post-hoc.
+- At-or-above the floor, the payload includes one 640 MiB parameter:
+  chunked D2H staging, ONE large storage object, and the concurrent
+  ranged-sub-read reassembly on restore are inside the certified loop.
+- A subprocess runs the sharded-entry save/restore with >512 MiB shards
+  (subdivided chunks) on an 8-virtual-device CPU mesh and its timings
+  land under ``"sharded_cpu"`` — path coverage at scale, explicitly not
+  a tunnel number. The payload clamp is 8 GiB so good tenancy windows
+  produce evidence closer to the reference's 18 GB runs.
+
 Env knobs:
   TPUSNAPSHOT_BENCH_BYTES          total parameter bytes (default:
                                    calibrated to ~45 s of take per run,
@@ -93,7 +113,76 @@ from torchsnapshot_tpu.ops.transfer import parallel_device_get  # noqa: E402
 _REFERENCE_SINGLE_ACCEL_GBPS = 0.44
 _TARGET_TAKE_SECONDS = 45.0
 _MIN_BENCH_BYTES = 64 * 1024**2
-_MAX_BENCH_BYTES = 2 * 1024**3
+# Opportunistic ceiling (VERDICT r3 #8): when calibration says the link
+# can carry it inside the budget, the payload grows toward the
+# reference's 18 GB runs instead of idling at the floor.
+_MAX_BENCH_BYTES = 8 * 1024**3
+# One parameter this large rides the big-object paths the 100 MiB grid
+# never touches (VERDICT r3 #3): chunked D2H staging of a single array,
+# ONE large storage object on the write side, and the concurrent
+# ranged-sub-read reassembly on restore.
+_BIG_PARAM_BYTES = 640 * 1024 * 1024
+
+
+def _restore_trace_breakdown(trace_path: str) -> dict:
+    """Aggregate a Chrome trace into {span_name: (total_s, count)}."""
+    try:
+        with open(trace_path) as f:
+            events = json.load(f)["traceEvents"]
+    except Exception:
+        return {}
+    begins, sums, counts = {}, {}, {}
+    for e in events:
+        if e.get("ph") == "b":
+            begins[e["id"]] = e
+        elif e.get("ph") == "e" and e.get("id") in begins:
+            b = begins.pop(e["id"])
+            name = b.get("name", "?")
+            sums[name] = sums.get(name, 0.0) + (e["ts"] - b["ts"]) / 1e6
+            counts[name] = counts.get(name, 0) + 1
+    return {n: (round(sums[n], 2), counts[n]) for n in sums}
+
+
+def _run_sharded_cpu_bench() -> dict:
+    """Timed sharded-entry save/restore with subdivided chunks, on an
+    8-virtual-device CPU mesh in a subprocess (VERDICT r3 #3: those
+    paths never appear inside the single-chip dense bench). Returns the
+    subprocess's JSON, or {"ok": False, ...} on any failure — coverage
+    evidence must never kill the headline run."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "sharded_cpu_bench.py",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        if proc.returncode != 0:
+            print(
+                f"[bench] sharded CPU bench failed (rc={proc.returncode}): "
+                f"{proc.stderr[-500:]}",
+                file=sys.stderr,
+            )
+            return {"ok": False, "error": f"rc={proc.returncode}"}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"[bench] sharded CPU bench failed: {e!r}", file=sys.stderr)
+        return {"ok": False, "error": repr(e)}
 
 
 def _floor_bytes() -> int:
@@ -293,10 +382,20 @@ def main() -> None:
                     file=sys.stderr,
                 )
         param_bytes = min(100 * 1024 * 1024, total_bytes)
+        # A floor-or-better payload includes ONE 640 MiB parameter so the
+        # certified run exercises the big-object paths (chunked D2H, one
+        # large storage object, split-read restore) alongside the
+        # reference-shaped 100 MiB grid. 640 MiB is an exact multiple of
+        # the 8/16 MiB transfer chunks: no odd-tail slice kernels.
+        use_big = (
+            total_bytes >= _floor_bytes()
+            and total_bytes >= _BIG_PARAM_BYTES + 2 * param_bytes
+        )
+        small_target = total_bytes - (_BIG_PARAM_BYTES if use_big else 0)
         # Round the parameter count UP: rounding down would shave a
         # floor-sized payload under the floor (1 GiB is not a multiple of
         # 100 MiB) and falsely mark every at-scale run degraded.
-        n_params = max(1, math.ceil(total_bytes / param_bytes))
+        n_params = max(1, math.ceil(small_target / param_bytes))
         if param_bytes != warm_param_bytes:
             # Calibration picked a different parameter shape than the
             # warmup used; warm the new shape's compiles — slice kernels
@@ -310,14 +409,45 @@ def main() -> None:
                 f"{bench_dir}/warmup2-async", {"model": rewarm}
             ).wait()
 
+        if use_big:
+            # Warm the big shape's compiles: D2H slice kernels + the
+            # async on-device clone are specialized on the operand shape,
+            # and the restore warms the big H2D reassembly so neither
+            # timed window pays first-compile.
+            bigwarm = SyntheticModel(
+                n_params=1, param_bytes=_BIG_PARAM_BYTES, seed=5
+            )
+            Snapshot.take(f"{bench_dir}/warmup-big", {"model": bigwarm})
+            Snapshot.async_take(
+                f"{bench_dir}/warmup-big-async", {"model": bigwarm}
+            ).wait()
+            bigwarm.params = {
+                k: jnp.zeros_like(v) for k, v in bigwarm.params.items()
+            }
+            Snapshot(f"{bench_dir}/warmup-big").restore({"model": bigwarm})
+            del bigwarm
+            print(
+                f"[bench] big-param warmup done "
+                f"({time.monotonic() - bench_start:.0f}s elapsed)",
+                file=sys.stderr,
+            )
+
         model = SyntheticModel(
             n_params=n_params, param_bytes=param_bytes, dtype=jnp.float32
         )
+        if use_big:
+            model.params["param_big"] = jax.random.normal(
+                jax.random.key(999),
+                (_BIG_PARAM_BYTES // 4,),
+                dtype=jnp.float32,
+            )
         jax.block_until_ready(list(model.params.values()))
         nbytes = model.total_bytes()
         print(
             f"[bench] payload: {nbytes / 1024**3:.2f} GiB "
-            f"({n_params} x {param_bytes >> 20} MiB)",
+            f"({n_params} x {param_bytes >> 20} MiB"
+            + (f" + 1 x {_BIG_PARAM_BYTES >> 20} MiB" if use_big else "")
+            + ")",
             file=sys.stderr,
         )
         app_state = {"model": model}
@@ -459,23 +589,43 @@ def main() -> None:
                 # link held; shrink when the takes already ran long
                 # (degraded tenancy): H2D is the slower direction and a
                 # full-size restore would double down on the overrun.
-                min(total_bytes, max(total_bytes // 4, _restore_floor_bytes()))
+                min(
+                    total_bytes,
+                    max(
+                        total_bytes // 4,
+                        _restore_floor_bytes(),
+                        _BIG_PARAM_BYTES if use_big else 0,
+                    ),
+                )
                 if not over_budget
                 else min(total_bytes // 4, 100 * 1024 * 1024),
             )
         )
-        n_restore = max(
-            1, min(n_params, math.ceil(restore_bytes / param_bytes))
-        )
-        restore_paths = [f"model/param_{i}" for i in range(n_restore)]
+        # Restore the big parameter FIRST when it fits the restore
+        # payload: the split-read reassembly of one large object is
+        # exactly the path the certified restore must cover; 100 MiB
+        # params fill the rest. (In shrink mode the big param would blow
+        # the reduced payload — skip it.)
+        parts = [(f"param_{i}", param_bytes) for i in range(n_params)]
+        if use_big and restore_bytes >= _BIG_PARAM_BYTES:
+            parts = [("param_big", _BIG_PARAM_BYTES)] + parts
+        restore_parts = []
+        acc = 0
+        for name, nb in parts:
+            if acc >= restore_bytes and restore_parts:
+                break
+            restore_parts.append(name)
+            acc += nb
+        restore_paths = [f"model/{name}" for name in restore_parts]
         target = SyntheticModel(n_params=1, param_bytes=1 << 20)
-        target.params = {
-            k: jnp.zeros_like(v) for k, v in model.params.items()
-        }
-        jax.block_until_ready(list(target.params.values()))
         force_sum = jax.jit(lambda xs: sum(jnp.sum(x) for x in xs))
         # Warm the reduction's compile outside the timed window.
-        float(force_sum([target.params[p.split("/", 1)[1]] for p in restore_paths]))
+        target.params = {
+            name: jnp.zeros_like(model.params[name])
+            for name in restore_parts
+        }
+        jax.block_until_ready(list(target.params.values()))
+        float(force_sum([target.params[n] for n in restore_parts]))
 
         # The restore timing is BRACKETED by H2D probes: the restore
         # window is tens of seconds on a link that swings
@@ -485,65 +635,117 @@ def main() -> None:
         # unstable — retry once; the attempt with the tighter probe
         # spread is reported, and the spread itself goes in the JSON so
         # a reader can judge the ratio's reliability.
+        restored_gib = acc / 1024**3
+        from torchsnapshot_tpu import tracing as _tracing
+
+        attempt_counter = [0]
+
         def _timed_restore():
+            attempt_counter[0] += 1
             target.params = {
-                k: jnp.zeros_like(v) for k, v in model.params.items()
+                name: jnp.zeros_like(model.params[name])
+                for name in restore_parts
             }
             jax.block_until_ready(list(target.params.values()))
+            trace_path = (
+                f"{bench_dir}/restore-trace-{attempt_counter[0]}.json"
+            )
             before = _probe_h2d_gbps()
+            _tracing.enable(trace_path)
             begin = time.monotonic()
             Snapshot(f"{bench_dir}/snap").restore(
                 {"model": target}, paths=restore_paths
             )
-            float(
-                force_sum(
-                    [target.params[p.split("/", 1)[1]] for p in restore_paths]
-                )
-            )
+            float(force_sum([target.params[n] for n in restore_parts]))
             elapsed = time.monotonic() - begin
+            _tracing.flush()
+            _tracing.disable()
             after = _probe_h2d_gbps()
             spread = max(before, after) / max(min(before, after), 1e-9)
+            # Per-phase breakdown from the trace spans (VERDICT r3 #1:
+            # a tunnel collapse — read/assemble-dominated — must be
+            # distinguishable from a code stall post-hoc). Span seconds
+            # are SUMS over concurrent spans, so they can exceed wall.
+            spans = _restore_trace_breakdown(trace_path)
             print(
                 f"[bench] restore {elapsed:.2f}s; H2D probes "
-                f"{before:.4f}/{after:.4f} GB/s (spread {spread:.2f}x)",
+                f"{before:.4f}/{after:.4f} GB/s (spread {spread:.2f}x); "
+                f"phase span-seconds (sum, n): "
+                + ", ".join(
+                    f"{n}={v[0]}s/{v[1]}" for n, v in sorted(spans.items())
+                ),
                 file=sys.stderr,
             )
             # The CEILING is the better probe (same convention as the
             # D2H probe: interference only subtracts) — a mean could
             # report restore/ceiling above 1.0, which is meaningless.
-            return elapsed, max(before, after), spread
+            return elapsed, max(before, after), spread, spans
 
-        restore_elapsed, h2d_gbps, h2d_spread = _timed_restore()
-        budget_remaining_s = total_budget_s - (
-            time.monotonic() - bench_start
-        )
-        if (
-            h2d_spread > 2.0
-            and not over_budget
-            # A retry re-runs a full restore + two probes; only attempt
-            # it when that plausibly fits what remains of the budget.
-            and budget_remaining_s > 2.5 * restore_elapsed
-        ):
+        def _ratio(att):
+            return (restored_gib / att[0]) / max(att[1], 1e-9)
+
+        # Retry discipline (VERDICT r3 #1): re-time when the probes
+        # disagree >2x (unstable window, as before) OR when the
+        # restore/ceiling ratio misses 0.5 — BENCH_r03 showed a
+        # mid-window tunnel collapse can recover before the trailing
+        # probe, yielding stable probes around a 14x-slow restore that
+        # spread-only retry certified as healthy.
+        attempts = [_timed_restore()]
+        while len(attempts) < 3:
+            best = max(attempts, key=_ratio)
+            unstable = best[2] > 2.0
+            slow = _ratio(best) < 0.5
+            budget_remaining_s = total_budget_s - (
+                time.monotonic() - bench_start
+            )
+            if not (unstable or slow):
+                break
+            if over_budget or budget_remaining_s < 2.5 * attempts[0][0]:
+                break
             print(
-                "[bench] H2D probes disagree >2x (unstable window); "
-                "re-timing the restore once",
+                f"[bench] re-timing restore (attempt {len(attempts) + 1}): "
+                + (
+                    "H2D probes disagree >2x (unstable window)"
+                    if unstable
+                    else f"restore/ceiling {_ratio(best):.2f} < 0.5 with "
+                    f"stable probes — mid-window collapse or code stall"
+                ),
                 file=sys.stderr,
             )
-            retry = _timed_restore()
-            if retry[2] < h2d_spread:
-                restore_elapsed, h2d_gbps, h2d_spread = retry
-        restored_gib = n_restore * param_bytes / 1024**3
+            attempts.append(_timed_restore())
+        restore_elapsed, h2d_gbps, h2d_spread, restore_spans = max(
+            attempts, key=_ratio
+        )
         restore_gbps = restored_gib / restore_elapsed
         restore_vs_ceiling = restore_gbps / max(h2d_gbps, 1e-9)
+        # A restore that still misses half its bracketed ceiling (or
+        # whose probes never stabilized) is NOT certified, whatever the
+        # payload size — the flag the r3 artifact lacked.
+        restore_uncertified = restore_vs_ceiling < 0.5 or h2d_spread > 2.0
+
+        # Sharded/subdivided write-path coverage (CPU mesh, subprocess):
+        # cheap relative to the tunnel work and independent of tenancy.
+        sharded_cpu = _run_sharded_cpu_bench()
+        print(f"[bench] sharded CPU path: {sharded_cpu}", file=sys.stderr)
 
         # Certification verdict: a result is degraded if either headline
         # payload fell below its floor (whatever the reason — collapsed
-        # link, exhausted budget, or an explicit small env override).
+        # link, exhausted budget, or an explicit small env override), or
+        # if the restore measurement itself failed its sanity gate.
         degraded = (
             degraded
             or nbytes < _floor_bytes()
             or restored_gib * 1024**3 < _restore_floor_bytes()
+            or restore_uncertified
         )
+        if restore_uncertified:
+            print(
+                f"[bench] RESTORE UNCERTIFIED: restore/ceiling "
+                f"{restore_vs_ceiling:.2f} (spread {h2d_spread:.2f}x) "
+                f"after {len(attempts)} attempt(s) — see the phase "
+                f"breakdown above for the root cause",
+                file=sys.stderr,
+            )
         if degraded:
             print(
                 "[bench] DEGRADED RESULT: below certification floor "
@@ -582,6 +784,16 @@ def main() -> None:
                     "restore_vs_ceiling": round(restore_vs_ceiling, 3),
                     "restore_bytes": int(restored_gib * 1024**3),
                     "n_take_runs": len(times),
+                    "n_restore_attempts": len(attempts),
+                    "restore_uncertified": restore_uncertified,
+                    "restore_read_span_s": restore_spans.get("read", (0, 0))[0],
+                    "restore_consume_span_s": restore_spans.get(
+                        "consume", (0, 0)
+                    )[0],
+                    "restore_assemble_span_s": restore_spans.get(
+                        "assemble", (0, 0)
+                    )[0],
+                    "sharded_cpu": sharded_cpu,
                     "degraded": degraded,
                 }
             )
@@ -596,6 +808,8 @@ def main() -> None:
             shutil.rmtree(f"{bench_dir}/warmup2", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup2-async", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup-async", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/warmup-big", ignore_errors=True)
+            shutil.rmtree(f"{bench_dir}/warmup-big-async", ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -43,6 +43,7 @@ import numpy as np
 
 import jax
 
+from . import tracing
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from .ops.transfer import (
     chunked_device_put,
@@ -735,6 +736,10 @@ class ArrayRestorePlan:
             if self._finalized:
                 return
             self._finalized = True
+        with tracing.span("assemble"):
+            self._finalize_impl()
+
+    def _finalize_impl(self) -> None:
         if self._template_is_jax:
             # One batched device_put for all shards: the runtime issues the
             # host→device transfers in parallel (a serial per-shard loop is
