@@ -810,6 +810,13 @@ def main() -> None:
             shutil.rmtree(f"{bench_dir}/warmup-async", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup-big", ignore_errors=True)
             shutil.rmtree(f"{bench_dir}/warmup-big-async", ignore_errors=True)
+            import glob as _glob
+
+            for trace in _glob.glob(f"{bench_dir}/restore-trace-*.json"):
+                try:
+                    os.remove(trace)
+                except OSError:
+                    pass
 
 
 if __name__ == "__main__":

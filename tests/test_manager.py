@@ -461,3 +461,20 @@ def test_reconcile_skips_tombstoned_steps(tmp_path, monkeypatch):
     fresh = CheckpointManager(base, max_to_keep=5)
     assert fresh.reconcile() == []
     assert fresh.all_steps() == [2]
+
+
+def test_inspect_cli_reconcile(tmp_path, capsys):
+    base = str(tmp_path / "run")
+    CheckpointManager(base).save(1, _state(1.0))
+    _orphan_step(base, 2, 2.0)
+
+    from torchsnapshot_tpu.inspect import main as inspect_main
+
+    assert inspect_main([base, "--reconcile", "adopt"]) == 0
+    out = capsys.readouterr()
+    assert out.out.strip() == "2"
+    assert "adopted 1 orphaned step(s)" in out.err
+    assert CheckpointManager(base).latest_step() == 2
+    # Nothing left: exit 0 with a notice.
+    assert inspect_main([base, "--reconcile", "adopt"]) == 0
+    assert "no orphaned steps" in capsys.readouterr().err

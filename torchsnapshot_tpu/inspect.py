@@ -92,6 +92,13 @@ def main(argv=None) -> int:
         help="treat PATH as a CheckpointManager base dir and list its "
         "committed steps",
     )
+    parser.add_argument(
+        "--reconcile",
+        choices=["adopt", "sweep"],
+        help="treat PATH as a CheckpointManager base dir and adopt "
+        "(write the missing step marker) or sweep (age-guarded delete) "
+        "async saves orphaned by a crash between commit and finalize",
+    )
     args = parser.parse_args(argv)
 
     exclusive = [
@@ -99,12 +106,28 @@ def main(argv=None) -> int:
         bool(args.delete or args.sweep),
         bool(args.convert_back),
         bool(args.steps),
+        bool(args.reconcile),
     ]
     if sum(exclusive) > 1:
         parser.error(
-            "--verify, --delete/--sweep, --convert-back, and --steps are "
-            "mutually exclusive; run them in separate invocations"
+            "--verify, --delete/--sweep, --convert-back, --steps, and "
+            "--reconcile are mutually exclusive; run them in separate "
+            "invocations"
         )
+    if args.reconcile:
+        from .manager import CheckpointManager
+
+        handled = CheckpointManager(args.path).reconcile(
+            adopt=(args.reconcile == "adopt")
+        )
+        verb = "adopted" if args.reconcile == "adopt" else "swept"
+        if not handled:
+            print("no orphaned steps", file=sys.stderr)
+            return 0
+        for step in handled:
+            print(step)
+        print(f"{verb} {len(handled)} orphaned step(s)", file=sys.stderr)
+        return 0
     if args.steps:
         from .manager import CheckpointManager
 

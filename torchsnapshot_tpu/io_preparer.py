@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from . import tracing
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
@@ -70,6 +71,7 @@ from .resharding import (
 from .serialization import (
     ARRAY_SERIALIZER,
     OBJECT_SERIALIZER,
+    StreamingCrc32,
     bytes_to_object,
     compress_payload,
     compute_checksum,
@@ -364,6 +366,10 @@ class _TargetRegion:
         self.sizes = sizes
         self.devices: List[Any] = []
         self.buffer = np.empty(sizes, dtype=dtype)
+        # Streaming split reads leave the region's data on device as an
+        # ordered list of 1-D chunks (finalize concatenates + reshapes
+        # on device instead of device_put-ing ``buffer``).
+        self.device_chunks: Optional[List[Any]] = None
 
 
 class _ChunkCopyConsumer(BufferConsumer):
@@ -502,6 +508,103 @@ class _SplitObjectReadState:
         if last:
             await self._inner.consume_buffer(memoryview(self._buf), executor)
             self._buf = None  # free eagerly
+
+
+class _StreamingSplitState(_SplitObjectReadState):
+    """Split read of one large object that STREAMS each completed
+    sub-range to the target device instead of waiting for full host
+    reassembly — overlapping storage reads with H2D transfers, which a
+    reassemble-then-put split serializes (measured: a pure 640 MiB
+    restore reached only 0.74 of the bracketed H2D ceiling because the
+    last sub-read gated the entire device transfer).
+
+    Only used when one uncompressed chunk exactly covers one
+    single-device region (the dominant shape: restoring a large dense
+    parameter). Integrity is unchanged: the crc32 is folded INCREMENTALLY
+    over the in-order byte stream as sub-ranges land (out-of-order
+    arrivals stash until their prefix completes — no full host
+    reassembly, and no end-of-stream hash pass on the critical path) and
+    checked BEFORE the plan's finalize exposes the array; the device
+    chunks are unreachable until then, and a mismatch raises with
+    nothing exposed."""
+
+    def __init__(
+        self,
+        nbytes: int,
+        region: "_TargetRegion",
+        dtype: np.dtype,
+        checksum: Optional[str],
+        on_done: Callable[[], None],
+    ) -> None:
+        super().__init__(nbytes, inner=None)  # inner unused
+        self._region = region
+        self._np_dtype = dtype
+        self._checksum = checksum
+        self._on_done = on_done
+        self._device = region.devices[0]
+        self._dev_chunks: Dict[int, Any] = {}  # start offset -> 1-D array
+        # Incremental crc (same no-op contract as verify_checksum for
+        # absent/unknown-algorithm checksums).
+        self._crc: Optional[StreamingCrc32] = (
+            StreamingCrc32()
+            if checksum and checksum.startswith("crc32:")
+            else None
+        )
+        self._next_off = 0
+        self._stash: Dict[int, BufferType] = {}
+
+    async def absorb(
+        self,
+        start: int,
+        end: int,
+        buf: BufferType,
+        executor: Optional[Executor] = None,
+    ) -> None:
+        def _consume_part() -> Any:
+            if len(buf) != end - start:
+                raise RuntimeError(
+                    f"Ranged sub-read returned {len(buf)} bytes for "
+                    f"[{start}, {end}) — object shorter than the manifest "
+                    f"implies (truncated or torn)."
+                )
+            flat = np.frombuffer(buf, dtype=self._np_dtype)
+            # Eager H2D first: the transfer rides the link while later
+            # sub-reads are still arriving from storage.
+            dev = chunked_device_put(flat, self._device)
+            if self._crc is not None:
+                with self._lock:
+                    self._stash[start] = buf
+                    while self._next_off in self._stash:
+                        b = self._stash.pop(self._next_off)
+                        self._crc.update(b)
+                        self._next_off += len(b)
+            return dev
+
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            dev = await loop.run_in_executor(executor, _consume_part)
+        else:
+            dev = _consume_part()
+        with self._lock:
+            self._dev_chunks[start] = dev
+            self._remaining -= 1
+            last = self._remaining == 0
+        if last:
+            if self._crc is not None:
+                actual = self._crc.tag()
+                if actual != self._checksum:
+                    raise RuntimeError(
+                        f"Checksum mismatch: stored object is corrupt "
+                        f"(expected {self._checksum}, got {actual})."
+                    )
+            self._region.device_chunks = [
+                self._dev_chunks[s] for s in sorted(self._dev_chunks)
+            ]
+            # Drop our references: once finalize concatenates, the
+            # per-sub-range arrays must be collectable or the restored
+            # array's HBM footprint doubles until the read loop exits.
+            self._dev_chunks.clear()
+            self._on_done()
 
 
 class _SubRangeConsumer(BufferConsumer):
@@ -695,32 +798,78 @@ class ArrayRestorePlan:
                 # Non-contiguous overlap somewhere: read the chunk once and
                 # scatter into every overlapping region. Whole-object reads
                 # can verify the stored checksum (ranged reads cannot).
-                consumer = _ChunkCopyConsumer(
-                    view_shape=list(chunk_sz),
-                    dtype=self._dtype,
-                    copies=[
-                        (region, region_slices, ov.chunk_slices)
-                        for region, region_slices, ov in copies
-                    ],
-                    checksum=chunk_checksum,
-                    compression=compression,
-                    on_done=self._on_req_done,
-                )
+                def _whole_consumer():
+                    return _ChunkCopyConsumer(
+                        view_shape=list(chunk_sz),
+                        dtype=self._dtype,
+                        copies=[
+                            (region, region_slices, ov.chunk_slices)
+                            for region, region_slices, ov in copies
+                        ],
+                        checksum=chunk_checksum,
+                        compression=compression,
+                        on_done=self._on_req_done,
+                    )
+
                 n_logical += 1
                 if compression is None and chunk_nbytes > split_threshold:
                     # Large whole-object read → concurrent ranged
-                    # sub-reads reassembled on host; the checksum is
-                    # verified over the assembled payload, so this stays
-                    # valid under TPUSNAPSHOT_STRICT_INTEGRITY.
-                    # (Compressed objects can't split: their stored size
-                    # is not derivable from the manifest shape.)
-                    state = _SplitObjectReadState(chunk_nbytes, consumer)
-                    reqs.extend(
-                        state.add_sub_reads(location, split_threshold)
+                    # sub-reads; the checksum is verified over the
+                    # assembled payload, so this stays valid under
+                    # TPUSNAPSHOT_STRICT_INTEGRITY. (Compressed objects
+                    # can't split: their stored size is not derivable
+                    # from the manifest shape.)
+                    region0, region_slices0, ov0 = copies[0]
+                    streamable = (
+                        self._template_is_jax
+                        and len(copies) == 1
+                        and len(region0.devices) == 1
+                        and list(ov0.sizes) == list(chunk_sz)
+                        and list(chunk_sz) == list(region0.sizes)
+                        and all(
+                            sl.start == 0 and sl.stop == dim
+                            for sl, dim in zip(
+                                region_slices0, region0.sizes
+                            )
+                        )
+                        and all(
+                            sl.start == 0 and sl.stop == dim
+                            for sl, dim in zip(ov0.chunk_slices, chunk_sz)
+                        )
                     )
+                    # Sub-range boundaries must land on element
+                    # boundaries for the streaming device chunks.
+                    part = max(
+                        itemsize,
+                        split_threshold - (split_threshold % itemsize),
+                    )
+                    if streamable:
+                        # Dominant shape (one big dense param, one
+                        # device): stream each sub-range to the device
+                        # as it lands, overlapping reads with H2D.
+                        stream = _StreamingSplitState(
+                            chunk_nbytes,
+                            region=region0,
+                            dtype=np.dtype(self._dtype),
+                            checksum=chunk_checksum,
+                            on_done=self._on_req_done,
+                        )
+                        # The host-side region buffer is never touched
+                        # on this path; drop it so a large restore does
+                        # not hold an idle full-size host allocation.
+                        region0.buffer = None
+                        reqs.extend(stream.add_sub_reads(location, part))
+                    else:
+                        state = _SplitObjectReadState(
+                            chunk_nbytes, _whole_consumer()
+                        )
+                        reqs.extend(state.add_sub_reads(location, part))
                 else:
                     reqs.append(
-                        ReadReq(path=location, buffer_consumer=consumer)
+                        ReadReq(
+                            path=location,
+                            buffer_consumer=_whole_consumer(),
+                        )
                     )
         with self._lock:
             # One finalize trigger per logical chunk (a split chunk's
@@ -749,35 +898,52 @@ class ArrayRestorePlan:
             # (ops/transfer.py chunked_device_put).
             buffers = []
             devices = []
+            prebuilt: Dict[int, Any] = {}
             for region in self._regions:
                 for device in region.devices:
+                    if region.device_chunks is not None:
+                        # Streaming split read: the bytes are already on
+                        # device as ordered 1-D chunks — concatenate +
+                        # reshape there instead of a host device_put.
+                        flat = (
+                            jnp.concatenate(region.device_chunks)
+                            if len(region.device_chunks) > 1
+                            else region.device_chunks[0]
+                        )
+                        prebuilt[len(buffers)] = jnp.reshape(
+                            flat, tuple(region.sizes)
+                        )
+                        region.device_chunks = None
                     buffers.append(region.buffer)
                     devices.append(device)
             chunk_mask = [
-                should_chunk_h2d(buf, dev)
-                for buf, dev in zip(buffers, devices)
+                False
+                if i in prebuilt
+                else should_chunk_h2d(buf, dev)
+                for i, (buf, dev) in enumerate(zip(buffers, devices))
             ]
-            if any(chunk_mask):
-                # Large buffers stream chunked; the small remainder still
-                # goes in ONE batched device_put (a per-buffer loop over
-                # many small shards is exactly the latency-bound path the
-                # batching exists to avoid).
-                small = [
-                    i for i, chunked in enumerate(chunk_mask) if not chunked
-                ]
-                arrays: List[Any] = [None] * len(buffers)
-                if small:
-                    put = jax.device_put(
-                        [buffers[i] for i in small],
-                        [devices[i] for i in small],
-                    )
-                    for i, arr in zip(small, put):
-                        arrays[i] = arr
-                for i, chunked in enumerate(chunk_mask):
-                    if chunked:
-                        arrays[i] = chunked_device_put(buffers[i], devices[i])
-            else:
-                arrays = jax.device_put(buffers, devices)
+            arrays: List[Any] = [None] * len(buffers)
+            for i, arr in prebuilt.items():
+                arrays[i] = arr
+            # Large buffers stream chunked; the small remainder still
+            # goes in ONE batched device_put (a per-buffer loop over
+            # many small shards is exactly the latency-bound path the
+            # batching exists to avoid).
+            small = [
+                i
+                for i, chunked in enumerate(chunk_mask)
+                if not chunked and i not in prebuilt
+            ]
+            if small:
+                put = jax.device_put(
+                    [buffers[i] for i in small],
+                    [devices[i] for i in small],
+                )
+                for i, arr in zip(small, put):
+                    arrays[i] = arr
+            for i, chunked in enumerate(chunk_mask):
+                if chunked:
+                    arrays[i] = chunked_device_put(buffers[i], devices[i])
             out = jax.make_array_from_single_device_arrays(
                 tuple(self._shape), self._sharding, arrays
             )
