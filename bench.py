@@ -564,7 +564,20 @@ def main() -> None:
         pending = Snapshot.async_take(f"{bench_dir}/snap-async", async_state)
         async_stall = time.monotonic() - async_begin
         print(f"[bench] async stall: {async_stall:.3f}s", file=sys.stderr)
-        pending.wait()
+        # Bounded waits so a tunnel collapse mid-drain (observed: an
+        # expected ~135 s drain taking 834 s) is visible in the log as
+        # it happens, with the drain's current phase, instead of a
+        # silent multi-minute gap.
+        while True:
+            try:
+                pending.wait(timeout_s=120.0)
+                break
+            except TimeoutError as e:
+                print(
+                    f"[bench] async drain still running after "
+                    f"{time.monotonic() - async_begin:.0f}s: {e}",
+                    file=sys.stderr,
+                )
         print(
             f"[bench] async drain done: {time.monotonic() - async_begin:.2f}s",
             file=sys.stderr,

@@ -394,3 +394,46 @@ def test_wait_timeout_bounds_hung_drain(tmp_path, monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(target["m"].sd["w"]), np.arange(8.0)
     )
+
+
+def test_wait_timeout_on_metadata_poll_is_retryable(tmp_path, monkeypatch):
+    """A wait() that times out in the METADATA poll (drain finished,
+    commit not yet observable — e.g. rank 0 still consolidating) must
+    leave the storage plugin open so a later wait() can resume polling
+    and succeed."""
+    import torchsnapshot_tpu.snapshot as snap_mod
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    release = threading.Event()
+    closes = []
+
+    class _HidingFS(FSStoragePlugin):
+        async def read(self, io_req):
+            if (
+                io_req.path == ".snapshot_metadata"
+                and not release.is_set()
+            ):
+                raise FileNotFoundError(io_req.path)
+            await super().read(io_req)
+
+        def close(self):
+            closes.append(True)
+            super().close()
+
+    monkeypatch.setattr(
+        snap_mod, "url_to_storage_plugin", lambda path: _HidingFS(path)
+    )
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"m": _Holder(StateDict(w=jnp.arange(4.0)))}
+    )
+    with pytest.raises(TimeoutError, match="metadata"):
+        pending.wait(timeout_s=2)
+    assert not closes  # storage stayed open for the retry
+    release.set()
+    snap = pending.wait(timeout_s=60)
+    assert closes  # closed on success
+    target = {"m": _Holder(StateDict(w=jnp.zeros(4)))}
+    snap.restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(4.0)
+    )

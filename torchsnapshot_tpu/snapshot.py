@@ -996,8 +996,15 @@ class PendingSnapshot:
                         timeout_s=max(0.0, deadline - time.monotonic()),
                     )
                 )
-        finally:
+        except TimeoutError:
+            # Keep the storage plugin OPEN: the handle is re-waitable
+            # after a timeout, and the next wait() resumes the metadata
+            # poll through it.
+            raise
+        except BaseException:
             self._storage.close()
+            raise
+        self._storage.close()
         if self._background.error is not None:
             raise self._background.error
         self._result = Snapshot(path=self.path, coord=self._coord)
