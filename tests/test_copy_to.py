@@ -1,0 +1,171 @@
+"""Snapshot.copy_to: backend→backend migration with in-transit
+verification and metadata-last commit (beyond reference parity — the
+reference leaves snapshot migration to external tooling like gsutil,
+which verifies nothing and has no commit point)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def _app(arr):
+    return {"m": _Holder({"w": arr, "meta": {"step": 7, "name": "run"}})}
+
+
+def test_copy_to_fs_round_trip(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = jnp.asarray(rng.standard_normal((128, 32)), dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(arr))
+    dst = str(tmp_path / "dst")
+    copied = Snapshot(src).copy_to(dst)
+    target = _app(jnp.zeros_like(arr))
+    copied.restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), arr)
+    assert target["m"].sd["meta"]["step"] == 7
+    # The copy stands alone: deleting the source must not affect it.
+    Snapshot(src).delete()
+    target2 = _app(jnp.zeros_like(arr))
+    Snapshot(dst).restore(target2)
+    np.testing.assert_array_equal(np.asarray(target2["m"].sd["w"]), arr)
+
+
+def test_copy_to_verifies_in_transit(tmp_path):
+    arr = jnp.arange(4096, dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(arr))
+    # Corrupt a payload on the SOURCE; the copy must refuse to
+    # propagate it and must not commit the destination.
+    obj = tmp_path / "src" / "0" / "m" / "w"
+    raw = bytearray(obj.read_bytes())
+    raw[100:104] = b"\xde\xad\xbe\xef"
+    obj.write_bytes(bytes(raw))
+    dst = str(tmp_path / "dst")
+    with pytest.raises(RuntimeError, match="[Cc]hecksum"):
+        Snapshot(src).copy_to(dst)
+    assert not (tmp_path / "dst" / ".snapshot_metadata").exists()
+
+
+def test_copy_to_interrupted_leaves_no_commit(tmp_path, monkeypatch):
+    """A copy that dies mid-payload leaves the destination invisible
+    (metadata-last), so a reader can never observe a half-copied
+    snapshot."""
+    import torchsnapshot_tpu.snapshot as snap_mod
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    arr = jnp.arange(8192, dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    app = _app(arr)
+    # A second array guarantees the copy has >= 2 payload writes, so
+    # the failure below lands mid-payload, before any metadata write.
+    app["m"].sd["w2"] = jnp.arange(64, dtype=jnp.float32)
+    Snapshot.take(src, app)
+
+    calls = {"n": 0}
+
+    class _DyingFS(FSStoragePlugin):
+        async def write(self, io_req):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise IOError("disk on fire")
+            await super().write(io_req)
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    orig = snap_mod.url_to_storage_plugin
+
+    def router(path):
+        if path.endswith("dst"):
+            return _DyingFS(path)
+        return orig(path)
+
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", router)
+    with pytest.raises(IOError, match="disk on fire"):
+        Snapshot(src).copy_to(str(tmp_path / "dst"))
+    assert not (tmp_path / "dst" / ".snapshot_metadata").exists()
+
+
+def test_copy_to_sharded_and_compressed(tmp_path):
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 devices")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices[:2]), ("x",))
+    arr = jnp.asarray(
+        np.random.default_rng(1).standard_normal((64, 16)), jnp.float32
+    )
+    sharded = jax.device_put(arr, NamedSharding(mesh, P("x", None)))
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(sharded), compression="zlib")
+    dst = str(tmp_path / "dst")
+    Snapshot(src).copy_to(dst)
+    target = _app(jnp.zeros_like(arr))
+    Snapshot(dst).restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), arr)
+
+
+def test_copy_to_fake_gcs(monkeypatch, tmp_path):
+    """fs → gs:// migration through the fake GCS client — the headline
+    use case (local checkpoint promoted to the cloud bucket)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_cloud_plugins import _FakeGCSClient
+
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.io_types import RetryingStoragePlugin
+    import torchsnapshot_tpu.snapshot as snap_mod
+
+    client = _FakeGCSClient()
+    orig = snap_mod.url_to_storage_plugin
+
+    def router(url):
+        if url.startswith("gs://"):
+            return RetryingStoragePlugin(
+                GCSStoragePlugin(root=url[len("gs://"):], client=client)
+            )
+        return orig(url)
+
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", router)
+    arr = jnp.arange(2048, dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(arr))
+    Snapshot(src).copy_to("gs://bucket/promoted")
+    target = _app(jnp.zeros_like(arr))
+    Snapshot("gs://bucket/promoted").restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(2048, dtype=np.float32)
+    )
+
+
+def test_inspect_cli_copy_to(tmp_path, capsys):
+    arr = jnp.arange(16, dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(arr))
+    from torchsnapshot_tpu.inspect import main as inspect_main
+
+    dst = str(tmp_path / "dst")
+    assert inspect_main([src, "--copy-to", dst]) == 0
+    assert "copied" in capsys.readouterr().out
+    target = _app(jnp.zeros_like(arr))
+    Snapshot(dst).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(16, dtype=np.float32)
+    )

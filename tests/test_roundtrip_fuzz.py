@@ -139,8 +139,16 @@ def test_generator_covers_every_leaf_kind():
 _N_SEEDS = 16
 
 
+@pytest.mark.parametrize("split_threshold", [None, 64])
 @pytest.mark.parametrize("seed", range(_N_SEEDS))
-def test_random_tree_roundtrip(seed, tmp_path):
+def test_random_tree_roundtrip(seed, split_threshold, tmp_path, monkeypatch):
+    # split_threshold=64 forces nearly every array restore through the
+    # split-read paths (host reassembly for numpy templates, device
+    # streaming for jax templates) across the same geometry.
+    if split_threshold is not None:
+        monkeypatch.setenv(
+            "TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", str(split_threshold)
+        )
     rng = random.Random(seed)
     tree = {"root": _rand_tree(rng, depth=3)}
     path = str(tmp_path / "snap")
@@ -153,8 +161,12 @@ def test_random_tree_roundtrip(seed, tmp_path):
         if hasattr(x, "shape"):
             arr = np.asarray(x)
             # Nonzero fill: an all-zero original (0-d/size-1 arange
-            # arrays are) must still differ from its sentinel.
-            return np.full(arr.shape, 1, arr.dtype)
+            # arrays are) must still differ from its sentinel. Jax-ness
+            # is preserved: a jax template restores through the device
+            # path (incl. streaming under a tiny split threshold), a
+            # numpy one through host reassembly.
+            filled = np.full(arr.shape, 1, arr.dtype)
+            return jnp.asarray(filled) if isinstance(x, jnp.ndarray) else filled
         if isinstance(x, bool):
             return not x
         if isinstance(x, int):

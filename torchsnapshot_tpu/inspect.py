@@ -99,6 +99,13 @@ def main(argv=None) -> int:
         "(write the missing step marker) or sweep (age-guarded delete) "
         "async saves orphaned by a crash between commit and finalize",
     )
+    parser.add_argument(
+        "--copy-to",
+        metavar="DEST",
+        help="copy this snapshot to another storage backend (e.g. "
+        "gs://bucket/path), verifying every payload checksum in "
+        "transit; the destination commits metadata-last",
+    )
     args = parser.parse_args(argv)
 
     exclusive = [
@@ -107,13 +114,18 @@ def main(argv=None) -> int:
         bool(args.convert_back),
         bool(args.steps),
         bool(args.reconcile),
+        bool(args.copy_to),
     ]
     if sum(exclusive) > 1:
         parser.error(
-            "--verify, --delete/--sweep, --convert-back, --steps, and "
-            "--reconcile are mutually exclusive; run them in separate "
-            "invocations"
+            "--verify, --delete/--sweep, --convert-back, --steps, "
+            "--reconcile, and --copy-to are mutually exclusive; run "
+            "them in separate invocations"
         )
+    if args.copy_to:
+        Snapshot(args.path).copy_to(args.copy_to)
+        print(f"copied {args.path} -> {args.copy_to} (verified in transit)")
+        return 0
     if args.reconcile:
         from .manager import CheckpointManager
 

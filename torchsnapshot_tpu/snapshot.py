@@ -589,6 +589,118 @@ class Snapshot:
         finally:
             storage.close()
 
+    def copy_to(self, dest_path: str, verify: bool = True) -> "Snapshot":
+        """Copy this committed snapshot to another storage backend
+        (beyond reference parity — migrating a torchsnapshot checkpoint
+        between backends requires external tooling like gsutil, which
+        verifies nothing and has no commit point).
+
+        Every manifest-referenced payload object is copied src→dest
+        with bounded concurrency; ``verify=True`` (default) checks each
+        payload against its recorded checksum IN TRANSIT, so silent
+        corruption on the source cannot propagate. The metadata
+        document is written LAST — the destination snapshot becomes
+        visible only after every payload landed (the same metadata-last
+        commit discipline as ``take``), so an interrupted copy leaves
+        an unreadable (and sweepable) prefix, never a readable snapshot
+        with missing payloads.
+
+        Single-process operation (like ``delete``/``verify``): run it
+        from one rank or an offline tool. Returns the destination
+        :class:`Snapshot`.
+        """
+        from .serialization import verify_checksum
+
+        from .serialization import array_nbytes
+
+        src = url_to_storage_plugin(self.path)
+        dst = url_to_storage_plugin(dest_path)
+        try:
+            metadata = self._read_snapshot_metadata(src)
+            by_loc: Dict[str, Any] = {}
+            for entry in _iter_payload_entries(metadata.manifest):
+                seen = by_loc.get(entry.location)
+                # Replicated payloads appear once per rank and only the
+                # stripe owner's entry carries a checksum — keep the
+                # checksum-bearing one so transit verification never
+                # silently no-ops on a non-owner duplicate.
+                if seen is None or (
+                    getattr(seen, "checksum", None) is None
+                    and getattr(entry, "checksum", None) is not None
+                ):
+                    by_loc[entry.location] = entry
+
+            def _est_nbytes(entry: Any) -> int:
+                if getattr(entry, "shape", None) is not None and getattr(
+                    entry, "dtype", None
+                ):
+                    return array_nbytes(entry.dtype, entry.shape)
+                return 64 * 1024 * 1024  # object entries: unknown size
+
+            async def _copy_all() -> None:
+                sem = asyncio.Semaphore(
+                    max(
+                        1,
+                        min(
+                            src.max_read_concurrency,
+                            dst.max_write_concurrency,
+                        ),
+                    )
+                )
+                # Dense objects are unbounded in size (only sharded
+                # writes subdivide), so concurrency alone does not bound
+                # host memory — admit payloads against a byte budget
+                # too. A single object larger than the whole budget
+                # still copies (alone).
+                budget = int(
+                    os.environ.get(
+                        "TPUSNAPSHOT_COPY_BUDGET_BYTES", 2 << 30
+                    )
+                )
+                in_flight = 0
+                gate = asyncio.Condition()
+
+                async def _one(loc: str, entry: Any) -> None:
+                    nonlocal in_flight
+                    est = _est_nbytes(entry)
+                    async with gate:
+                        await gate.wait_for(
+                            lambda: in_flight == 0
+                            or in_flight + est <= budget
+                        )
+                        in_flight += est
+                    try:
+                        async with sem:
+                            io_req = IOReq(path=loc)
+                            await src.read(io_req)
+                            payload = io_payload(io_req)
+                            if verify:
+                                # Compressed payloads checksum the
+                                # stored (compressed) bytes — exactly
+                                # what is being copied — so transit
+                                # verification needs no decompression.
+                                verify_checksum(
+                                    payload,
+                                    getattr(entry, "checksum", None),
+                                )
+                            out = IOReq(path=loc, data=payload)
+                            await dst.write(out)
+                    finally:
+                        async with gate:
+                            in_flight -= est
+                            gate.notify_all()
+
+                await asyncio.gather(
+                    *(_one(loc, e) for loc, e in by_loc.items())
+                )
+
+            asyncio.run(_copy_all())
+            _write_snapshot_metadata(dst, metadata)
+        finally:
+            src.close()
+            dst.close()
+        return Snapshot(path=dest_path)
+
     # ------------------------------------------------------------- internals
 
     def get_manifest(self) -> Manifest:
