@@ -478,3 +478,16 @@ def test_inspect_cli_reconcile(tmp_path, capsys):
     # Nothing left: exit 0 with a notice.
     assert inspect_main([base, "--reconcile", "adopt"]) == 0
     assert "no orphaned steps" in capsys.readouterr().err
+
+
+def test_reconcile_on_init(tmp_path):
+    """The job-startup hook: a fresh manager constructed with
+    reconcile_on_init='adopt' resumes from a step orphaned by a crash
+    between the background commit and finalize."""
+    base = str(tmp_path / "run")
+    CheckpointManager(base).save(1, _state(1.0))
+    _orphan_step(base, 2, 2.0)
+    fresh = CheckpointManager(base, reconcile_on_init="adopt")
+    assert fresh.latest_step() == 2
+    with pytest.raises(ValueError, match="reconcile_on_init"):
+        CheckpointManager(base, reconcile_on_init="bogus")

@@ -69,20 +69,35 @@ class CheckpointManager:
         max_to_keep: Optional[int] = None,
         keep_period: Optional[int] = None,
         coord: Optional[Coordinator] = None,
+        reconcile_on_init: Optional[str] = None,
     ) -> None:
         """``max_to_keep`` bounds retained checkpoints; ``keep_period``
         additionally ARCHIVES every checkpoint whose step is a multiple
         of it — archived steps never count against ``max_to_keep`` and
         are never pruned (the orbax retention contract: a rolling recent
-        window plus periodic keepers for post-hoc evaluation)."""
+        window plus periodic keepers for post-hoc evaluation).
+
+        ``reconcile_on_init`` ("adopt" or "sweep") runs
+        :meth:`reconcile` once at construction — the job-startup hook
+        for recovering async saves orphaned by a crash between commit
+        and finalize. Construction-time reconcile is storage-only: in a
+        multi-rank job, pass it on ONE rank (typically 0) or call
+        :meth:`reconcile` explicitly there."""
         if max_to_keep is not None and max_to_keep < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         if keep_period is not None and keep_period < 1:
             raise ValueError(f"keep_period must be >= 1, got {keep_period}")
+        if reconcile_on_init not in (None, "adopt", "sweep"):
+            raise ValueError(
+                f"reconcile_on_init must be None, 'adopt', or 'sweep'; "
+                f"got {reconcile_on_init!r}"
+            )
         self.base_path = base_path
         self.max_to_keep = max_to_keep
         self.keep_period = keep_period
         self._coord = coord
+        if reconcile_on_init is not None:
+            self.reconcile(adopt=(reconcile_on_init == "adopt"))
 
     # ------------------------------------------------------------- steps
 
