@@ -4,6 +4,7 @@ bounded step stall; SURVEY §7 step 8)."""
 import threading
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -436,4 +437,44 @@ def test_wait_timeout_on_metadata_poll_is_retryable(tmp_path, monkeypatch):
     snap.restore(target)
     np.testing.assert_array_equal(
         np.asarray(target["m"].sd["w"]), np.arange(4.0)
+    )
+
+
+def test_clone_oom_check_knob(tmp_path, monkeypatch):
+    """TPUSNAPSHOT_CLONE_OOM_CHECK=0 removes the synchronous
+    block_until_ready from the consistent-cut clone (the dominant part
+    of the async-take stall on a tunneled device); the round trip stays
+    bit-exact either way."""
+    import torchsnapshot_tpu.ops.transfer as transfer_mod
+
+    calls = []
+    orig = jax.block_until_ready
+
+    def counting(x):
+        calls.append(1)
+        return orig(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+    arrs = [jnp.arange(64.0), jnp.ones((8, 8))]
+
+    clones = transfer_mod.device_clone(arrs)
+    assert len(calls) == 1  # default: one batched OOM-check wait
+    np.testing.assert_array_equal(np.asarray(clones[0]), np.arange(64.0))
+
+    calls.clear()
+    monkeypatch.setenv("TPUSNAPSHOT_CLONE_OOM_CHECK", "0")
+    clones = transfer_mod.device_clone(arrs)
+    assert calls == []  # no blocking wait on the stall path
+    np.testing.assert_array_equal(np.asarray(clones[1]), np.ones((8, 8)))
+
+    # Whole async take under the knob: still bit-exact.
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"),
+        {"m": _Holder(StateDict(w=jnp.arange(32.0)))},
+    )
+    snap = pending.wait()
+    target = {"m": _Holder(StateDict(w=jnp.zeros(32)))}
+    snap.restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(32.0)
     )
