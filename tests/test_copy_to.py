@@ -155,6 +155,55 @@ def test_copy_to_fake_gcs(monkeypatch, tmp_path):
     )
 
 
+def test_copy_to_malformed_budget_env_falls_back(tmp_path, monkeypatch):
+    """A malformed TPUSNAPSHOT_COPY_BUDGET_BYTES must log-and-default
+    like the sibling env knobs, not abort the copy (ADVICE r4)."""
+    monkeypatch.setenv("TPUSNAPSHOT_COPY_BUDGET_BYTES", "not-a-number")
+    arr = jnp.arange(256, dtype=jnp.float32)
+    src = str(tmp_path / "src")
+    Snapshot.take(src, _app(arr))
+    dst = str(tmp_path / "dst")
+    Snapshot(src).copy_to(dst)
+    target = _app(jnp.zeros_like(arr))
+    Snapshot(dst).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.arange(256, dtype=np.float32)
+    )
+
+
+def test_copy_to_sizes_object_entries_from_backend(tmp_path, monkeypatch):
+    """Object entries carry no size in the manifest; copy_to must admit
+    them against the byte budget at their STORED size (backend stat),
+    not a token flat estimate (ADVICE r4)."""
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    sized_paths = []
+    orig = FSStoragePlugin.object_size_bytes
+
+    async def _spy(self, path):
+        size = await orig(self, path)
+        sized_paths.append((path, size))
+        return size
+
+    monkeypatch.setattr(FSStoragePlugin, "object_size_bytes", _spy)
+    src = str(tmp_path / "src")
+    # A set is not a flattenable container/primitive: it persists as a
+    # pickled object entry with no size in the manifest.
+    app = {"m": _Holder({"w": jnp.arange(8, dtype=jnp.float32),
+                         "tags": {"a", "b", "c"}})}
+    Snapshot.take(src, app)
+    dst = str(tmp_path / "dst")
+    Snapshot(src).copy_to(dst)
+    assert sized_paths, "object entries should be stat-sized"
+    for path, size in sized_paths:
+        assert "tags" in path
+        real = (tmp_path / "src" / path).stat().st_size
+        assert size == real > 0
+    target = {"m": _Holder({"w": jnp.zeros(8, jnp.float32), "tags": set()})}
+    Snapshot(dst).restore(target)
+    assert target["m"].sd["tags"] == {"a", "b", "c"}
+
+
 def test_inspect_cli_copy_to(tmp_path, capsys):
     arr = jnp.arange(16, dtype=jnp.float32)
     src = str(tmp_path / "src")

@@ -1,12 +1,18 @@
 """Fused attention kernel: numerics vs the einsum reference, gradient
 flow, and transformer integration. Runs the same Pallas kernel the TPU
-executes, in interpreter mode on the hermetic CPU suite."""
+executes, in interpreter mode on the hermetic CPU suite.
+
+Marked ``slow``: Pallas interpreter mode multiplies trace time by the
+grid size, pushing this file past the fast tier's wall-clock budget on a
+single-core host. Run with ``-m slow`` (or no ``-m`` filter)."""
 
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow
 
 from torchsnapshot_tpu.ops.attention import (
     _reference_attention,

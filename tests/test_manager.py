@@ -444,6 +444,28 @@ def test_reconcile_sweep_spares_young_orphans(tmp_path, monkeypatch):
     assert (tmp_path / "run" / "step-7" / ".snapshot_metadata").exists()
 
 
+def test_reconcile_sweep_spares_unknown_age_orphans(tmp_path, monkeypatch):
+    """A backend that cannot report an object's age (GCS blob with no
+    ``updated`` field, soft-None paths) must fail CLOSED: the orphan was
+    just listed so its commit object exists, and sweeping it could
+    destroy a just-committed async save (ADVICE r4). Setting
+    TPUSNAPSHOT_SWEEP_MIN_AGE_S=0 remains the explicit escape hatch
+    (guard disabled, sweep regardless of age)."""
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "3600")
+
+    async def _no_age(self, path):
+        return None
+
+    monkeypatch.setattr(FSStoragePlugin, "object_age_s", _no_age)
+    base = str(tmp_path / "run")
+    _orphan_step(base, 7, 7.0)
+    fresh = CheckpointManager(base)
+    assert fresh.reconcile(adopt=False) == []
+    assert (tmp_path / "run" / "step-7" / ".snapshot_metadata").exists()
+
+
 def test_reconcile_skips_tombstoned_steps(tmp_path, monkeypatch):
     """A step mid-prune (marker deleted, payloads pending, tombstone
     present) is NOT an orphan: adopting it would resurrect a checkpoint

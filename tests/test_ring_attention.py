@@ -1,5 +1,9 @@
 """Ring attention (sequence parallelism over the mesh) vs the dense
-einsum reference, on the 8-device virtual mesh."""
+einsum reference, on the 8-device virtual mesh.
+
+Marked ``slow``: the inner flash kernel runs in Pallas interpreter mode
+on the hermetic CPU suite, once per ring step per device. Run with
+``-m slow`` (or no ``-m`` filter)."""
 
 import numpy as np
 import pytest
@@ -7,6 +11,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+pytestmark = pytest.mark.slow
 
 from torchsnapshot_tpu.ops.attention import _reference_attention
 from torchsnapshot_tpu.parallel.ring_attention import ring_attention, shard_seq

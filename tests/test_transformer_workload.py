@@ -6,7 +6,13 @@ elastic restore onto a different pod shape" config, scaled down to the
 8-device virtual CPU mesh: train a few steps, snapshot (sync and
 device-staged async), then restore onto a differently-shaped mesh and
 continue training — losses must match bit-exactly.
-"""
+
+Marked ``slow``: the flagship model's attention runs the Pallas kernel
+in interpreter mode on the hermetic CPU suite, so each train step costs
+minutes of trace time on a single-core host. The snapshot machinery the
+file integrates is covered in the fast tier by test_snapshot /
+test_elastic / test_roundtrip_fuzz. Run with ``-m slow`` (or no ``-m``
+filter)."""
 
 import numpy as np
 import pytest
@@ -15,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+pytestmark = pytest.mark.slow
 
 from torchsnapshot_tpu import Snapshot
 from torchsnapshot_tpu.models.transformer import (

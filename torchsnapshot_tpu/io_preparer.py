@@ -46,6 +46,7 @@ import jax.numpy as jnp
 
 from . import tracing
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .utils.env import env_int
 from .ops.transfer import (
     chunked_device_put,
     device_clone,
@@ -98,17 +99,9 @@ _DEFAULT_PARALLEL_READ_THRESHOLD = 64 * 1024 * 1024
 
 
 def _parallel_read_threshold() -> int:
-    raw = os.environ.get(_PARALLEL_READ_THRESHOLD_ENV_VAR)
-    if raw is None:
-        return _DEFAULT_PARALLEL_READ_THRESHOLD
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning(
-            f"Ignoring malformed {_PARALLEL_READ_THRESHOLD_ENV_VAR}={raw!r}; "
-            f"using default {_DEFAULT_PARALLEL_READ_THRESHOLD}"
-        )
-        return _DEFAULT_PARALLEL_READ_THRESHOLD
+    return env_int(
+        _PARALLEL_READ_THRESHOLD_ENV_VAR, _DEFAULT_PARALLEL_READ_THRESHOLD
+    )
 
 _PRIMITIVE_TYPES = (int, float, bool, str, complex, type(None))
 
@@ -459,6 +452,30 @@ class _SplitObjectReadState:
         self._buf: Optional[bytearray] = None  # allocated on first absorb
         self._remaining = 0
         self._lock = threading.Lock()
+        # Scheduler budget-release callback for the shared assembly
+        # reservation (charged as the first sub-read's deferred cost,
+        # re-credited only here — when the buffer is actually freed —
+        # so concurrent split reads cannot overrun the read budget).
+        self._cost_release: Optional[Callable[[int], None]] = None
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        self._cost_release = release
+
+    def extra_first_cost_bytes(self) -> int:
+        """Cost charged on top of the first sub-read's payload: the
+        shared host assembly buffer."""
+        return self.nbytes
+
+    def deferred_cost_bytes(self, first: bool, part_nbytes: int) -> int:
+        """Portion of a sub-read's consuming cost whose allocation
+        outlives its consume: the assembly buffer, carried by the first
+        sub-read, freed when the LAST one lands."""
+        return self.nbytes if first else 0
+
+    def _release_assembly_cost(self) -> None:
+        release, self._cost_release = self._cost_release, None
+        if release is not None:
+            release(self.nbytes)
 
     def add_sub_reads(self, path: str, part_size: int) -> List[ReadReq]:
         reqs = []
@@ -506,8 +523,11 @@ class _SplitObjectReadState:
             self._remaining -= 1
             last = self._remaining == 0
         if last:
-            await self._inner.consume_buffer(memoryview(self._buf), executor)
-            self._buf = None  # free eagerly
+            try:
+                await self._inner.consume_buffer(memoryview(self._buf), executor)
+            finally:
+                self._buf = None  # free eagerly
+                self._release_assembly_cost()
 
 
 class _StreamingSplitState(_SplitObjectReadState):
@@ -552,6 +572,32 @@ class _StreamingSplitState(_SplitObjectReadState):
         )
         self._next_off = 0
         self._stash: Dict[int, BufferType] = {}
+        self._released = 0  # deferred bytes already re-credited
+
+    def extra_first_cost_bytes(self) -> int:
+        # No host assembly buffer: parts go straight to device. Charging
+        # the whole object on the first sub-read would serialize
+        # concurrent large streaming restores under a tight budget —
+        # defeating the read/H2D overlap this class exists for.
+        return 0
+
+    def deferred_cost_bytes(self, first: bool, part_nbytes: int) -> int:
+        # With an incremental crc, an out-of-order part is stashed on
+        # host until its prefix drains — its payload outlives the
+        # consume. Released per-part from the drain loop.
+        return part_nbytes if self._crc is not None else 0
+
+    def _release_assembly_cost(self) -> None:
+        # Error-path safety net: re-credit whatever the drain loop has
+        # not already released (on success the final drain covers the
+        # whole object and this is a no-op).
+        release, self._cost_release = self._cost_release, None
+        if release is not None:
+            with self._lock:
+                remaining = self.nbytes - self._released
+                self._released = self.nbytes
+            if remaining > 0:
+                release(remaining)
 
     async def absorb(
         self,
@@ -572,12 +618,21 @@ class _StreamingSplitState(_SplitObjectReadState):
             # sub-reads are still arriving from storage.
             dev = chunked_device_put(flat, self._device)
             if self._crc is not None:
+                drained = 0
                 with self._lock:
                     self._stash[start] = buf
                     while self._next_off in self._stash:
                         b = self._stash.pop(self._next_off)
                         self._crc.update(b)
                         self._next_off += len(b)
+                        drained += len(b)
+                    release = self._cost_release
+                    if release is not None and drained:
+                        self._released += drained
+                # Re-credit drained parts outside the state lock (the
+                # budget cell takes its own lock).
+                if release is not None and drained:
+                    release(drained)
             return dev
 
         if executor is not None:
@@ -590,21 +645,24 @@ class _StreamingSplitState(_SplitObjectReadState):
             self._remaining -= 1
             last = self._remaining == 0
         if last:
-            if self._crc is not None:
-                actual = self._crc.tag()
-                if actual != self._checksum:
-                    raise RuntimeError(
-                        f"Checksum mismatch: stored object is corrupt "
-                        f"(expected {self._checksum}, got {actual})."
-                    )
-            self._region.device_chunks = [
-                self._dev_chunks[s] for s in sorted(self._dev_chunks)
-            ]
-            # Drop our references: once finalize concatenates, the
-            # per-sub-range arrays must be collectable or the restored
-            # array's HBM footprint doubles until the read loop exits.
-            self._dev_chunks.clear()
-            self._on_done()
+            try:
+                if self._crc is not None:
+                    actual = self._crc.tag()
+                    if actual != self._checksum:
+                        raise RuntimeError(
+                            f"Checksum mismatch: stored object is corrupt "
+                            f"(expected {self._checksum}, got {actual})."
+                        )
+                self._region.device_chunks = [
+                    self._dev_chunks[s] for s in sorted(self._dev_chunks)
+                ]
+                # Drop our references: once finalize concatenates, the
+                # per-sub-range arrays must be collectable or the restored
+                # array's HBM footprint doubles until the read loop exits.
+                self._dev_chunks.clear()
+                self._on_done()
+            finally:
+                self._release_assembly_cost()
 
 
 class _SubRangeConsumer(BufferConsumer):
@@ -624,13 +682,28 @@ class _SubRangeConsumer(BufferConsumer):
         await self._state.absorb(self._start, self._end, buf, executor)
 
     def get_consuming_cost_bytes(self) -> int:
-        # The first sub-read carries the assembly buffer's cost (the
-        # scheduler dispatches reads in list order, so it is admitted
-        # before the others); each sub-read additionally charges its own
-        # payload. The inner consumer's view is zero-copy over the
-        # assembly buffer, so its cost is not double-charged.
-        extra = self._state.nbytes if self._first else 0
+        # Each sub-read charges its own payload; the first additionally
+        # carries the state's shared-allocation cost (the host assembly
+        # buffer — zero for streaming states, which have none). The
+        # scheduler dispatches reads in list order, so the first is
+        # admitted before the others. The inner consumer's view is
+        # zero-copy over the assembly buffer, so its cost is not
+        # double-charged.
+        extra = self._state.extra_first_cost_bytes() if self._first else 0
         return (self._end - self._start) + extra
+
+    def get_deferred_cost_bytes(self) -> int:
+        # The deferred portion's allocation outlives this consume (the
+        # assembly buffer until the LAST sub-read; a streamed part's
+        # stash entry until the crc prefix drains), so its reservation is
+        # released through the scheduler's callback when actually freed,
+        # not at consume completion.
+        return self._state.deferred_cost_bytes(
+            self._first, self._end - self._start
+        )
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        self._state.set_cost_releaser(release)
 
 
 class ArrayRestorePlan:

@@ -20,7 +20,7 @@ import logging
 import os
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
-from typing import Optional, Union
+from typing import Callable, Optional, Union
 
 BufferType = Union[bytes, bytearray, memoryview]
 
@@ -134,13 +134,10 @@ _RETRY_BACKOFF_INITIAL_S = 0.25
 
 
 def _storage_attempts() -> int:
+    from .utils.env import env_int
+
     return 1 + max(
-        0,
-        int(
-            os.environ.get(
-                _STORAGE_RETRIES_ENV_VAR, _DEFAULT_STORAGE_ATTEMPTS - 1
-            )
-        ),
+        0, env_int(_STORAGE_RETRIES_ENV_VAR, _DEFAULT_STORAGE_ATTEMPTS - 1)
     )
 
 
@@ -190,6 +187,22 @@ class BufferConsumer(abc.ABC):
     @abc.abstractmethod
     def get_consuming_cost_bytes(self) -> int:
         """Peak host memory charged against the budget while consuming."""
+
+    def get_deferred_cost_bytes(self) -> int:
+        """The portion of :meth:`get_consuming_cost_bytes` whose backing
+        allocation outlives this consumer's ``consume_buffer`` call (e.g.
+        a split read's shared assembly buffer, freed only when the LAST
+        sub-read lands). The scheduler refunds this portion through the
+        releaser callback instead of at consume-task completion, so
+        several concurrent split reads cannot overrun the budget by the
+        sum of their object sizes. 0 for ordinary consumers."""
+        return 0
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        """Receive the scheduler's budget-release callback. Only called
+        when :meth:`get_deferred_cost_bytes` returns non-zero; the
+        consumer must invoke ``release(n)`` exactly once, when the
+        deferred allocation is actually freed."""
 
 
 @dataclass
@@ -260,6 +273,14 @@ class StoragePlugin(abc.ABC):
         object is swept unconditionally (pre-age-guard behavior)."""
         return None
 
+    async def object_size_bytes(self, path: str) -> Optional[int]:
+        """Stored size of ``path`` in bytes (a stat/HEAD, not a read), or
+        None when the backend cannot tell. ``copy_to`` admits object
+        entries — whose size the manifest does not record — against its
+        host-memory budget with this; unknown sizes degrade to
+        copy-alone admission."""
+        return None
+
     @abc.abstractmethod
     def close(self) -> None:
         ...
@@ -312,6 +333,11 @@ class RetryingStoragePlugin(StoragePlugin):
         # treating a throttled probe as "unknown age, sweep it".
         return await retry_storage_op(
             lambda: self._inner.object_age_s(path), f"age({path})"
+        )
+
+    async def object_size_bytes(self, path: str) -> Optional[int]:
+        return await retry_storage_op(
+            lambda: self._inner.object_size_bytes(path), f"size({path})"
         )
 
     def close(self) -> None:

@@ -38,6 +38,7 @@ from .io_types import IOReq, is_not_found_error
 from .snapshot import _COMPLETION_TIMEOUT_S, PendingSnapshot, Snapshot
 from .stateful import AppState
 from .storage_plugin import url_to_storage_plugin
+from .utils.env import env_float
 
 logger = logging.getLogger(__name__)
 
@@ -199,23 +200,36 @@ class CheckpointManager:
                     # Age-guard on the commit point: a just-committed
                     # orphan may be an async save whose wait() simply
                     # has not run yet.
-                    try:
-                        min_age_s = float(
-                            os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600)
-                        )
-                    except ValueError:
-                        min_age_s = 3600.0
-                    age = asyncio.run(
-                        storage.object_age_s(
-                            f"step-{step}/.snapshot_metadata"
-                        )
+                    min_age_s = env_float(
+                        "TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600.0
                     )
-                    if age is not None and age < min_age_s:
-                        logger.info(
-                            f"reconcile: sparing young orphan step {step} "
-                            f"(age {age:.0f}s < {min_age_s:.0f}s)"
+                    if min_age_s > 0:
+                        age = asyncio.run(
+                            storage.object_age_s(
+                                f"step-{step}/.snapshot_metadata"
+                            )
                         )
-                        continue
+                        if age is None:
+                            # Fail closed (ADVICE r4): the commit object
+                            # was just listed, so it exists — a backend
+                            # that cannot report its age must not be read
+                            # as "old enough to sweep", or a
+                            # just-committed async save gets destroyed.
+                            # Setting TPUSNAPSHOT_SWEEP_MIN_AGE_S=0
+                            # disables the guard explicitly.
+                            logger.info(
+                                f"reconcile: sparing orphan step {step} "
+                                f"(backend cannot report age; treating "
+                                f"as younger than {min_age_s:.0f}s)"
+                            )
+                            continue
+                        if age < min_age_s:
+                            logger.info(
+                                f"reconcile: sparing young orphan step "
+                                f"{step} (age {age:.0f}s < "
+                                f"{min_age_s:.0f}s)"
+                            )
+                            continue
                     Snapshot(_step_dir(self.base_path, step)).delete(
                         sweep=True
                     )

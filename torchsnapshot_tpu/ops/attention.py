@@ -77,9 +77,10 @@ def resolve_flash_block(seq_len: int) -> int:
     with much smaller VMEM may need a smaller cap —
     ``TPUSNAPSHOT_FLASH_BLOCK_CAP`` overrides it without code changes."""
     import math
-    import os
 
-    cap = int(os.environ.get("TPUSNAPSHOT_FLASH_BLOCK_CAP", 1024))
+    from ..utils.env import env_int
+
+    cap = env_int("TPUSNAPSHOT_FLASH_BLOCK_CAP", 1024)
     block = math.gcd(seq_len, cap)
     if block < 8:
         raise ValueError(

@@ -117,9 +117,9 @@ _DEFAULT_H2D_CHUNK_BYTES = 16 * 1024 * 1024
 
 
 def h2d_chunk_bytes() -> int:
-    return int(
-        os.environ.get("TPUSNAPSHOT_H2D_CHUNK_BYTES", _DEFAULT_H2D_CHUNK_BYTES)
-    )
+    from ..utils.env import env_int
+
+    return env_int("TPUSNAPSHOT_H2D_CHUNK_BYTES", _DEFAULT_H2D_CHUNK_BYTES)
 
 
 def should_chunk_h2d(arr: Any, device: Any) -> bool:

@@ -15,7 +15,11 @@ un-reaped, scheduler.py:133-135 — both fixed here):
 
 - write: charge ``staging_cost`` at dispatch; on stage completion re-credit
   ``staging_cost − len(buf)``; on write completion re-credit ``len(buf)``.
-- read: charge ``consuming_cost`` at dispatch; re-credit it after consume.
+- read: charge ``consuming_cost`` at dispatch; re-credit it after consume —
+  except a consumer's *deferred* portion (a split read's shared assembly
+  buffer, which outlives the individual sub-read consumes), which the
+  consumer re-credits through a releaser callback when the allocation is
+  actually freed.
 
 At least one request is always in flight regardless of budget so a single
 over-budget buffer cannot deadlock the pipeline (reference
@@ -27,6 +31,7 @@ import io
 import logging
 import os
 import socket
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -164,6 +169,29 @@ async def execute_write_reqs(
     return bytes_written
 
 
+class _BudgetCell:
+    """Mutable budget shared with consumers holding deferred reservations
+    (split-read assembly buffers, streaming-split crc stashes): ``release``
+    re-credits when the backing allocation is actually freed, not when a
+    consume task completes. Locked: streaming splits release from executor
+    threads as their in-order prefix drains, racing the event loop's
+    charge/refund."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, value: int) -> None:
+        self.value = value
+        self._lock = threading.Lock()
+
+    def charge(self, nbytes: int) -> None:
+        with self._lock:
+            self.value -= nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.value += nbytes
+
+
 async def execute_read_reqs(
     read_reqs: List[ReadReq],
     storage: StoragePlugin,
@@ -175,18 +203,22 @@ async def execute_read_reqs(
     pending = deque(read_reqs)
     reading: Dict[asyncio.Task, Tuple[ReadReq, int]] = {}
     consuming: Dict[asyncio.Task, int] = {}
-    budget = memory_budget_bytes
+    budget = _BudgetCell(memory_budget_bytes)
     bytes_read = 0
     max_io = storage.max_read_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
     try:
         while pending or reading or consuming:
             while pending and len(reading) < max_io:
-                cost = pending[0].buffer_consumer.get_consuming_cost_bytes()
+                consumer = pending[0].buffer_consumer
+                cost = consumer.get_consuming_cost_bytes()
                 nothing_in_flight = not (reading or consuming)
-                if budget >= cost or nothing_in_flight:
+                if budget.value >= cost or nothing_in_flight:
                     rr = pending.popleft()
-                    budget -= cost
+                    budget.charge(cost)
+                    deferred = consumer.get_deferred_cost_bytes()
+                    if deferred:
+                        consumer.set_cost_releaser(budget.release)
                     io_req = IOReq(path=rr.path, byte_range=rr.byte_range)
 
                     async def _read(io_req=io_req, path=rr.path) -> IOReq:
@@ -195,7 +227,9 @@ async def execute_read_reqs(
                         return io_req
 
                     task = asyncio.ensure_future(_read())
-                    reading[task] = (rr, cost)
+                    # The consume-completion refund excludes the deferred
+                    # portion, which the consumer releases itself.
+                    reading[task] = (rr, cost - deferred)
                 else:
                     break
 
@@ -220,7 +254,7 @@ async def execute_read_reqs(
                 else:
                     cost = consuming.pop(task)
                     task.result()  # propagate consume errors
-                    budget += cost
+                    budget.release(cost)
     finally:
         executor.shutdown(wait=False)
     elapsed = time.monotonic() - begin_ts

@@ -81,6 +81,7 @@ from .scheduler import (
 )
 from .stateful import AppState, Stateful
 from .storage_plugin import url_to_storage_plugin
+from .utils.env import env_int
 from .version import __version__
 
 logger = logging.getLogger(__name__)
@@ -630,13 +631,6 @@ class Snapshot:
                 ):
                     by_loc[entry.location] = entry
 
-            def _est_nbytes(entry: Any) -> int:
-                if getattr(entry, "shape", None) is not None and getattr(
-                    entry, "dtype", None
-                ):
-                    return array_nbytes(entry.dtype, entry.shape)
-                return 64 * 1024 * 1024  # object entries: unknown size
-
             async def _copy_all() -> None:
                 sem = asyncio.Semaphore(
                     max(
@@ -652,17 +646,31 @@ class Snapshot:
                 # host memory — admit payloads against a byte budget
                 # too. A single object larger than the whole budget
                 # still copies (alone).
-                budget = int(
-                    os.environ.get(
-                        "TPUSNAPSHOT_COPY_BUDGET_BYTES", 2 << 30
-                    )
-                )
+                budget = env_int("TPUSNAPSHOT_COPY_BUDGET_BYTES", 2 << 30)
+
+                async def _est_nbytes(entry: Any, loc: str) -> int:
+                    if getattr(entry, "shape", None) is not None and getattr(
+                        entry, "dtype", None
+                    ):
+                        return array_nbytes(entry.dtype, entry.shape)
+                    # Object entries: the manifest records no size, so ask
+                    # the backend (a stat/HEAD). A backend that cannot
+                    # tell returns None — admit the payload at FULL budget
+                    # so it copies alone rather than letting a multi-GiB
+                    # pickle slip in at a token estimate (ADVICE r4).
+                    size = await src.object_size_bytes(loc)
+                    return budget if size is None else size
+
                 in_flight = 0
                 gate = asyncio.Condition()
 
                 async def _one(loc: str, entry: Any) -> None:
                     nonlocal in_flight
-                    est = _est_nbytes(entry)
+                    # Under the IO semaphore: N object entries must not
+                    # fire N simultaneous stat/HEADs (one TLS client
+                    # each on the S3 aio path).
+                    async with sem:
+                        est = await _est_nbytes(entry, loc)
                     async with gate:
                         await gate.wait_for(
                             lambda: in_flight == 0
@@ -1350,22 +1358,7 @@ def _metadata_compress_threshold() -> int:
     # Read per-call (like the sibling commit-route knob): the documented
     # rolling-upgrade workflow sets the env var from training-script
     # setup code, which may run after this module imports.
-    raw = os.environ.get("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD")
-    if raw is None:
-        return 1 << 20
-    try:
-        return int(raw)
-    except ValueError:
-        # A malformed knob must not raise inside _encode_metadata_doc —
-        # that runs during commit, inside a collective, so one rank's
-        # typo would strand every other rank until the coordinator
-        # timeout. Same log-and-default contract as the sibling
-        # _commit_via_storage_threshold knob (ADVICE r3).
-        logger.warning(
-            "Ignoring malformed TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD="
-            f"{raw!r}; using default {1 << 20}"
-        )
-        return 1 << 20
+    return env_int("TPUSNAPSHOT_METADATA_COMPRESS_THRESHOLD", 1 << 20)
 
 
 def _encode_metadata_doc(doc: str) -> bytes:
@@ -1731,19 +1724,9 @@ _DEFAULT_COMMIT_VIA_STORAGE_BYTES = 1 << 20
 
 
 def _commit_via_storage_threshold() -> int:
-    raw = os.environ.get(_COMMIT_VIA_STORAGE_ENV_VAR)
-    if raw is None:
-        return _DEFAULT_COMMIT_VIA_STORAGE_BYTES
-    try:
-        return int(raw)
-    except ValueError:
-        # A config typo must not crash take() inside a collective (every
-        # other rank would block until the coordinator timeout).
-        logger.warning(
-            f"Ignoring malformed {_COMMIT_VIA_STORAGE_ENV_VAR}={raw!r}; "
-            f"using default {_DEFAULT_COMMIT_VIA_STORAGE_BYTES}"
-        )
-        return _DEFAULT_COMMIT_VIA_STORAGE_BYTES
+    return env_int(
+        _COMMIT_VIA_STORAGE_ENV_VAR, _DEFAULT_COMMIT_VIA_STORAGE_BYTES
+    )
 
 
 async def _acommit_via_storage(

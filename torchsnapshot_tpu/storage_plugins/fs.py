@@ -109,5 +109,11 @@ class FSStoragePlugin(StoragePlugin):
         # age guard fails closed on them instead of sweeping blind.
         return max(0.0, time.time() - st.st_mtime)
 
+    async def object_size_bytes(self, path: str) -> Optional[int]:
+        try:
+            return os.stat(os.path.join(self.root, path)).st_size
+        except FileNotFoundError:
+            return None
+
     def close(self) -> None:
         pass

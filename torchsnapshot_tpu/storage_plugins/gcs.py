@@ -212,5 +212,24 @@ class GCSStoragePlugin(StoragePlugin):
                 return None
             raise
 
+    def _size_sync(self, path: str):
+        blob = self._blob(path)
+        blob.reload()
+        size = getattr(blob, "size", None)
+        return None if size is None else int(size)
+
+    async def object_size_bytes(self, path: str):
+        from ..io_types import is_not_found_error
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._executor, self._size_sync, path
+            )
+        except Exception as e:
+            if is_not_found_error(e):
+                return None
+            raise
+
     def close(self) -> None:
         self._executor.shutdown(wait=True)

@@ -82,5 +82,10 @@ class MemoryStoragePlugin(StoragePlugin):
             mtime = self._mtimes.get(path)
         return None if mtime is None else max(0.0, time.time() - mtime)
 
+    async def object_size_bytes(self, path: str):
+        async with self._lock:
+            data = self.store.get(path)
+        return None if data is None else len(data)
+
     def close(self) -> None:
         pass

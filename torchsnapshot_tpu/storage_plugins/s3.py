@@ -168,6 +168,33 @@ class S3StoragePlugin(StoragePlugin):
                 return None
             raise
 
+    async def object_size_bytes(self, path: str):
+        from ..io_types import is_not_found_error
+
+        def _from_head(head) -> Optional[int]:
+            size = head.get("ContentLength")
+            return None if size is None else int(size)
+
+        try:
+            if self._mode == "aio":
+                async with self._session.create_client("s3") as client:
+                    head = await client.head_object(
+                        Bucket=self.bucket, Key=self._key(path)
+                    )
+                return _from_head(head)
+            loop = asyncio.get_running_loop()
+            head = await loop.run_in_executor(
+                self._executor,
+                lambda: self._client.head_object(
+                    Bucket=self.bucket, Key=self._key(path)
+                ),
+            )
+            return _from_head(head)
+        except Exception as e:
+            if is_not_found_error(e):
+                return None
+            raise
+
     def close(self) -> None:
         if self._mode == "sync":
             self._executor.shutdown(wait=True)
