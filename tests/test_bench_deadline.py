@@ -1,0 +1,81 @@
+"""bench.py hard-deadline discipline (VERDICT r4 #1): whatever the
+tunnel does, the bench's stdout carries exactly one parsed JSON summary
+line and the process exits 0 — the r4 artifact was an rc=124 kill with
+no JSON after a collapsed link pushed the phases past the driver window.
+
+Both tests run bench.py as a subprocess on CPU with
+TPUSNAPSHOT_BENCH_THROTTLE_GBPS simulating the collapsed link. Marked
+``slow``: each burns tens of seconds of real wall-clock by design.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO_ROOT, "bench.py")
+
+
+def _run_bench(tmp_path, budget_s: int, throttle_gbps: float, nbytes: int):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "TPUSNAPSHOT_BENCH_THROTTLE_GBPS": str(throttle_gbps),
+            "TPUSNAPSHOT_BENCH_TOTAL_BUDGET_S": str(budget_s),
+            "TPUSNAPSHOT_BENCH_BYTES": str(nbytes),
+            "TPUSNAPSHOT_BENCH_DIR": str(tmp_path),
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, _BENCH],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=budget_s + 60,  # the bench must beat this comfortably
+        cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    doc = json.loads(lines[0])
+    assert doc["metric"] == "snapshot_take_GBps"
+    return doc, proc
+
+
+def test_bench_supervisor_emits_when_stuck_in_one_call(tmp_path):
+    """A link so slow the WARMUP take cannot finish inside the budget:
+    the body thread is stuck inside one blocking Snapshot.take, so only
+    the supervisor can emit. rc=0 + parsed JSON + abort reason."""
+    # 100 MiB warmup at 0.002 GB/s ≈ 50 s > the 40 s budget.
+    doc, proc = _run_bench(
+        tmp_path, budget_s=40, throttle_gbps=0.002, nbytes=256 << 20
+    )
+    assert doc["degraded"] is True
+    assert doc["abort"] and "stuck" in doc["abort"]
+    assert doc["wall_s"] <= 50  # emitted at the deadline, not the kill
+    assert "HARD DEADLINE" in proc.stderr
+
+
+def test_bench_phase_gate_aborts_gracefully_with_partial_results(tmp_path):
+    """A link that carries the warmup and one take but not the restore:
+    the body's own deadline gate fires between phases, so the summary
+    carries the CERTIFIED take numbers plus the abort reason."""
+    # Warmup ~10 s, one 512 MiB take ~25 s at 0.02 GB/s, then the
+    # restore gate (needs 60 s) fails against the ~90 s budget.
+    doc, _ = _run_bench(
+        tmp_path, budget_s=90, throttle_gbps=0.02, nbytes=512 << 20
+    )
+    assert doc["degraded"] is True
+    assert doc["abort"] is not None
+    # The take DID complete and its numbers are in the artifact.
+    assert doc["n_take_runs"] >= 1
+    assert doc["value"] is not None and doc["value"] > 0
+    assert doc["take_vs_ceiling"] is not None
+    assert doc["wall_s"] <= 95
