@@ -1,0 +1,308 @@
+"""Format-level chunking of large dense arrays (VERDICT r4 #3).
+
+A dense array larger than ``MAX_CHUNK_SIZE_BYTES`` persists as a chunked
+``ShardedArrayEntry`` — multiple one-region storage objects — instead of
+one monolithic object, so bounded staging, write fan-out, and
+split/streaming restores stop depending on per-backend tricks. The
+reference subdivides only ShardedTensor shards
+(torchsnapshot/io_preparer.py:38,40-72); the dense path here gets the
+same treatment while preserving the dense entry's elasticity category
+(replicated / per-rank).
+
+Tests shrink the threshold via monkeypatch so the chunk machinery runs
+at MiB scale hermetically.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchsnapshot_tpu.io_preparer as iop
+from torchsnapshot_tpu import Snapshot
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+from torchsnapshot_tpu.manifest import ShardedArrayEntry
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    """1 MiB chunk ceiling: a few-MiB array exercises the same chunking
+    a 1.5 GiB param hits at the default 512 MiB."""
+    monkeypatch.setattr(iop, "MAX_CHUNK_SIZE_BYTES", 1 << 20)
+
+
+def _big_array(nbytes=3 * (1 << 20) + 512 * 1024, seed=0):
+    rng = np.random.default_rng(seed)
+    n = nbytes // 4
+    return jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+
+
+def test_large_dense_writes_multiple_objects(tmp_path, small_chunks):
+    arr = _big_array()  # 3.5 MiB -> 4 chunks at a 1 MiB ceiling
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+
+    manifest = Snapshot(path).get_manifest()
+    entry = manifest["0/m/w"]
+    assert isinstance(entry, ShardedArrayEntry)
+    assert entry.per_rank and not entry.replicated
+    assert len(entry.shards) >= 3
+    for shard in entry.shards:
+        # One-region chunks in the owner's namespace, each a real object.
+        assert shard.array.location.startswith("0/m/w_")
+        assert (tmp_path / "snap" / shard.array.location).exists()
+        assert shard.array.checksum is not None
+    # Chunks tile the array exactly.
+    covered = sum(s.sizes[0] for s in entry.shards)
+    assert covered == arr.shape[0]
+
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )
+
+
+def test_chunked_dense_restores_to_numpy_and_resharded(tmp_path, small_chunks):
+    arr = _big_array(seed=1)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+
+    # Host template.
+    target = {"m": _Holder({"w": np.zeros(arr.shape, np.float32)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(target["m"].sd["w"], np.asarray(arr))
+
+    # Mesh-sharded template: chunk boundaries do not align with the
+    # 8-way partition, exercising the overlap math chunk x shard.
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharded_zero = jax.device_put(
+        jnp.zeros_like(arr), NamedSharding(mesh, P("x"))
+    )
+    target2 = {"m": _Holder({"w": sharded_zero})}
+    Snapshot(path).restore(target2)
+    out = target2["m"].sd["w"]
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("x")), arr.ndim
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_chunked_dense_verify_delete_copy_account_every_object(
+    tmp_path, small_chunks
+):
+    arr = _big_array(seed=2)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    snap = Snapshot(path)
+    entry = snap.get_manifest()["0/m/w"]
+    locations = [s.array.location for s in entry.shards]
+    assert len(locations) >= 3
+
+    assert snap.verify() == {}
+    # Corrupt ONE chunk: verify must name exactly that object.
+    victim = tmp_path / "snap" / locations[1]
+    raw = bytearray(victim.read_bytes())
+    raw[10] ^= 0xFF
+    victim.write_bytes(bytes(raw))
+    problems = snap.verify()
+    assert set(problems) == {locations[1]}
+
+    # copy_to moves every chunk (and refuses the corrupt one by default).
+    with pytest.raises(RuntimeError):
+        snap.copy_to(str(tmp_path / "copy-fail"))
+    raw[10] ^= 0xFF  # heal
+    victim.write_bytes(bytes(raw))
+    copied = snap.copy_to(str(tmp_path / "copy"))
+    for loc in locations:
+        assert (tmp_path / "copy" / loc).exists()
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    copied.restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )
+
+    # delete removes every chunk object.
+    snap.delete()
+    for loc in locations:
+        assert not (tmp_path / "snap" / loc).exists()
+
+
+def test_chunked_dense_async_take_round_trip(tmp_path, small_chunks):
+    arr = _big_array(seed=3)
+    path = str(tmp_path / "snap")
+    pending = Snapshot.async_take(path, {"m": _Holder({"w": arr})})
+    pending.wait()
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )
+
+
+def _run_world(world, fn):
+    store = DictStore()
+    errors = []
+    results = [None] * world
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=60)
+            results[rank] = fn(coord, rank)
+        except BaseException as e:  # pragma: no cover
+            import traceback
+
+            errors.append((rank, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed:\n{errors[0][2]}")
+    return results
+
+
+def test_chunked_dense_replicated_stripe_owner_writes_once(
+    tmp_path, small_chunks
+):
+    """A replicated large dense param chunks AND stripes: the negotiated
+    owner writes every chunk exactly once into replicated/, checksums
+    come from the owner, and every rank can restore."""
+    path = str(tmp_path / "snap")
+    arr = _big_array(seed=4)
+
+    def worker(coord, rank):
+        app = {"m": _Holder({"w": arr})}
+        Snapshot.take(path, app, coord=coord, replicated=["**"])
+        return None
+
+    _run_world(2, worker)
+
+    snap = Snapshot(path)
+    manifest = snap.get_manifest()
+    for r in range(2):
+        entry = manifest[f"{r}/m/w"]
+        assert isinstance(entry, ShardedArrayEntry)
+        assert entry.replicated and not entry.per_rank
+    # One set of chunk objects, under replicated/.
+    chunk_files = sorted(
+        p.name for p in (tmp_path / "snap" / "replicated" / "m").iterdir()
+    )
+    assert len(chunk_files) >= 3
+    assert all(name.startswith("w_") for name in chunk_files)
+    # The merged view carries the owner's checksums.
+    assert snap.verify() == {}
+
+    # Every rank restores bit-exactly (including a world-size change).
+    def restore_worker(coord, rank):
+        target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+        Snapshot(path).restore(target, coord=coord)
+        np.testing.assert_array_equal(
+            np.asarray(target["m"].sd["w"]), np.asarray(arr)
+        )
+
+    _run_world(3, restore_worker)
+
+
+def test_chunked_dense_per_rank_values_stay_per_rank(tmp_path, small_chunks):
+    """Two ranks' same-named large per-rank values must NOT merge: each
+    rank restores its own bytes, and storage paths never collide."""
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        arr = _big_array(seed=10 + rank)
+        Snapshot.take(path, {"m": _Holder({"w": arr})}, coord=coord)
+        return None
+
+    _run_world(2, worker)
+
+    manifest = Snapshot(path).get_manifest()
+    locs0 = {s.array.location for s in manifest["0/m/w"].shards}
+    locs1 = {s.array.location for s in manifest["1/m/w"].shards}
+    assert not (locs0 & locs1)
+
+    def restore_worker(coord, rank):
+        expected = _big_array(seed=10 + rank)
+        target = {"m": _Holder({"w": jnp.zeros_like(expected)})}
+        Snapshot(path).restore(target, coord=coord)
+        np.testing.assert_array_equal(
+            np.asarray(target["m"].sd["w"]), np.asarray(expected)
+        )
+
+    _run_world(2, restore_worker)
+
+
+class _StubCoordinator:
+    """Single-threaded stand-in reporting an arbitrary rank/world (the
+    test_elastic.py pattern for probing one rank's view)."""
+
+    def __init__(self, rank, world):
+        self._rank, self._world = rank, world
+
+    def get_rank(self):
+        return self._rank
+
+    def get_world_size(self):
+        return self._world
+
+    def barrier(self, timeout_s=None):
+        pass
+
+    def all_gather_object(self, obj):
+        return [obj] * self._world
+
+    def broadcast_object(self, obj, src=0):
+        return obj
+
+
+def test_chunked_dense_per_rank_elasticity_error(tmp_path, small_chunks):
+    """Restoring a per-rank chunked value with a grown world produces
+    the actionable elasticity error, exactly like a dense per-rank
+    entry (reference snapshot.py:388-406)."""
+    path = str(tmp_path / "snap")
+
+    def worker(coord, rank):
+        arr = _big_array(seed=20 + rank)
+        Snapshot.take(path, {"m": _Holder({"w": arr})}, coord=coord)
+
+    _run_world(2, worker)
+
+    # Rank 2 of a hypothetical world=3 has no per-rank entry.
+    target = {"m": _Holder({"w": jnp.zeros(896 * 1024, jnp.float32)})}
+    with pytest.raises(RuntimeError, match="only elastic"):
+        Snapshot(path).restore(target, coord=_StubCoordinator(rank=2, world=3))
+
+
+def test_chunked_dense_2d_and_compression(tmp_path, small_chunks):
+    """2-D arrays chunk along the largest dim; compressed chunks
+    round-trip (each chunk compresses independently)."""
+    rng = np.random.default_rng(7)
+    arr = jnp.asarray(rng.standard_normal((1536, 512)), jnp.float32)  # 3 MiB
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})}, compression="zlib")
+    entry = Snapshot(path).get_manifest()["0/m/w"]
+    assert isinstance(entry, ShardedArrayEntry)
+    assert len(entry.shards) >= 3
+    assert all(s.array.compression == "zlib" for s in entry.shards)
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )

@@ -106,6 +106,17 @@ class ShardedArrayEntry(Entry):
     shards: List[Shard]
     # For sharded jax PRNG key arrays (see ArrayEntry.prng_impl).
     prng_impl: Optional[str] = None
+    # Ownership category for CHUNKED DENSE entries (a large unsharded
+    # array subdivided into multiple storage objects for bounded staging
+    # and write fan-out — VERDICT r4 #3). Mesh-sharded entries leave both
+    # False: their per-rank shard lists merge by union. A chunked dense
+    # value sets exactly one: ``replicated`` (stripe-owner writes; every
+    # rank may restore) or ``per_rank`` (each rank's own value; restore
+    # availability is owner-only, like a dense per-rank ArrayEntry —
+    # union-merging different ranks' same-named per-rank values would
+    # interleave their chunks).
+    replicated: bool = False
+    per_rank: bool = False
 
     def __init__(
         self,
@@ -113,12 +124,16 @@ class ShardedArrayEntry(Entry):
         shape: List[int],
         shards: List[Shard],
         prng_impl: Optional[str] = None,
+        replicated: bool = False,
+        per_rank: bool = False,
     ) -> None:
         super().__init__(type="ShardedArray")
         self.dtype = dtype
         self.shape = list(shape)
         self.shards = shards
         self.prng_impl = prng_impl
+        self.replicated = replicated
+        self.per_rank = per_rank
 
 
 @dataclass
@@ -226,6 +241,8 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
             "dtype": entry.dtype,
             "shape": entry.shape,
             "prng_impl": entry.prng_impl,
+            "replicated": entry.replicated,
+            "per_rank": entry.per_rank,
             "shards": [
                 {
                     "offsets": s.offsets,
@@ -284,6 +301,8 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             "shape": d["shape"],
             "shards": shards,
             "prng_impl": d.get("prng_impl"),
+            "replicated": d.get("replicated", False),
+            "per_rank": d.get("per_rank", False),
         }
         return e
     d = dict(d)
@@ -416,7 +435,10 @@ class SnapshotMetadata:
 
 def is_replicated(entry: Entry) -> bool:
     return (
-        isinstance(entry, (ArrayEntry, ObjectEntry, PrimitiveEntry))
+        isinstance(
+            entry,
+            (ArrayEntry, ObjectEntry, PrimitiveEntry, ShardedArrayEntry),
+        )
         and entry.replicated
     )
 
@@ -452,22 +474,34 @@ def get_available_entries(manifest: Manifest, rank: int) -> Manifest:
     available: Manifest = {}
     for local_path, by_rank in grouped.items():
         sample = next(iter(by_rank.values()))
-        if isinstance(sample, ShardedArrayEntry):
-            merged_shards: List[Shard] = []
-            seen = set()
+        if isinstance(sample, ShardedArrayEntry) and sample.per_rank:
+            # Chunked dense per-rank value: every rank has its OWN array
+            # under this logical path, so availability is owner-only —
+            # union-merging would interleave different ranks' chunks.
+            if rank in by_rank:
+                available[local_path] = by_rank[rank]
+        elif isinstance(sample, ShardedArrayEntry):
+            merged: Dict[Any, Shard] = {}
             for owner in sorted(by_rank):
                 entry = by_rank[owner]
                 assert isinstance(entry, ShardedArrayEntry)
                 for shard in entry.shards:
                     key = (tuple(shard.offsets), tuple(shard.sizes))
-                    if key not in seen:
-                        seen.add(key)
-                        merged_shards.append(shard)
+                    current = merged.get(key)
+                    # Prefer the checksum-bearing duplicate: for chunked
+                    # replicated entries only the stripe owner staged the
+                    # bytes, so only its shard entries carry checksums.
+                    if current is None or (
+                        current.array.checksum is None
+                        and shard.array.checksum is not None
+                    ):
+                        merged[key] = shard
             available[local_path] = ShardedArrayEntry(
                 dtype=sample.dtype,
                 shape=sample.shape,
-                shards=merged_shards,
+                shards=list(merged.values()),
                 prng_impl=sample.prng_impl,
+                replicated=sample.replicated,
             )
         elif is_replicated(sample):
             # Prefer the entry carrying a checksum: only the stripe owner

@@ -1257,7 +1257,11 @@ def _save_stateful(
             compression=compression,
             eager_host_copy=eager_host_copy,
         )
-        if isinstance(entry, ShardedArrayEntry):
+        if isinstance(entry, ShardedArrayEntry) and not entry.replicated:
+            # Mesh-sharded values matched by a replicated glob route
+            # through the sharded writer-dedup instead of striping.
+            # Chunked DENSE entries keep their negotiated category: the
+            # stripe owner writes every chunk.
             replicated = False
         manifest_out[logical_path] = entry
         if replicated and replicated_owner[logical_path] != rank:
@@ -1666,6 +1670,16 @@ def _load_stateful(
     return len(selected)
 
 
+def _entry_has_checksum(entry: Entry) -> bool:
+    """Whether this entry records integrity tags for its stored bytes —
+    a dense/object entry's own checksum, or (chunked dense) any shard's.
+    Only the stripe owner of a replicated value stages bytes, so only
+    its entry carries checksums."""
+    if isinstance(entry, ShardedArrayEntry):
+        return any(s.array.checksum is not None for s in entry.shards)
+    return getattr(entry, "checksum", None) is not None
+
+
 def _merge_manifests(all_manifests: List[Manifest]) -> Manifest:
     """Merge per-process manifests into the global rank-prefixed view.
 
@@ -1684,8 +1698,8 @@ def _merge_manifests(all_manifests: List[Manifest]) -> Manifest:
                 # checksum of the bytes actually stored.
                 current = replicated_entries.get(logical_path)
                 if current is None or (
-                    getattr(entry, "checksum", None)
-                    and not getattr(current, "checksum", None)
+                    _entry_has_checksum(entry)
+                    and not _entry_has_checksum(current)
                 ):
                     replicated_entries[logical_path] = entry
     for logical_path, entry in replicated_entries.items():
