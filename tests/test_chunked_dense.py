@@ -63,8 +63,10 @@ def test_large_dense_writes_multiple_objects(tmp_path, small_chunks):
     assert entry.per_rank and not entry.replicated
     assert len(entry.shards) >= 3
     for shard in entry.shards:
-        # One-region chunks in the owner's namespace, each a real object.
-        assert shard.array.location.startswith("0/m/w_")
+        # One-region chunks in the owner's slice of the dedicated
+        # chunk namespace (disjoint from dense leaf locations, so a
+        # sibling leaf literally named "w__chunk_0" can never collide).
+        assert shard.array.location.startswith("chunked/0/m/w__chunk_")
         assert (tmp_path / "snap" / shard.array.location).exists()
         assert shard.array.checksum is not None
     # Chunks tile the array exactly.
@@ -201,12 +203,13 @@ def test_chunked_dense_replicated_stripe_owner_writes_once(
         entry = manifest[f"{r}/m/w"]
         assert isinstance(entry, ShardedArrayEntry)
         assert entry.replicated and not entry.per_rank
-    # One set of chunk objects, under replicated/.
+    # One set of chunk objects, under the replicated chunk namespace.
     chunk_files = sorted(
-        p.name for p in (tmp_path / "snap" / "replicated" / "m").iterdir()
+        p.name
+        for p in (tmp_path / "snap" / "chunked" / "replicated" / "m").iterdir()
     )
     assert len(chunk_files) >= 3
-    assert all(name.startswith("w_") for name in chunk_files)
+    assert all(name.startswith("w__chunk_") for name in chunk_files)
     # The merged view carries the owner's checksums.
     assert snap.verify() == {}
 

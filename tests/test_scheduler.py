@@ -144,15 +144,15 @@ class _DeferredConsumer(BufferConsumer):
         async def _later():
             await self.release_gate.wait()
             self.events.append("released")
-            self._release(100)
+            self._release(150)
 
         asyncio.ensure_future(_later())
 
     def get_consuming_cost_bytes(self) -> int:
-        return 100
+        return 150
 
     def get_deferred_cost_bytes(self) -> int:
-        return 100
+        return 150
 
     def set_cost_releaser(self, release):
         self._release = release
@@ -163,12 +163,14 @@ def test_deferred_cost_held_until_release():
     consume task completes: a same-cost read behind it is only admitted
     once the consumer's releaser fires (ADVICE r4 medium — without this,
     concurrent split reads overrun the budget by the sum of their
-    assembly buffers)."""
+    assembly buffers). All three requests share one cost so the
+    largest-first dispatch sort keeps their list order (stable tie)."""
     events = []
 
     class _GatedConsumer(BufferConsumer):
-        # Keeps the pipeline non-empty (suppressing the ≥1-in-flight
-        # forced admission) until it unblocks the deferred release.
+        # Holds a never-refunded deferred reservation and keeps the
+        # pipeline non-empty while it unblocks A's release — so the ONLY
+        # budget that can admit B is A's released reservation.
         def __init__(self, release_gate):
             self.release_gate = release_gate
 
@@ -178,14 +180,20 @@ def test_deferred_cost_held_until_release():
             events.append("C consumed")
 
         def get_consuming_cost_bytes(self) -> int:
-            return 50
+            return 150
+
+        def get_deferred_cost_bytes(self) -> int:
+            return 150
+
+        def set_cost_releaser(self, release):
+            pass  # never released within this pipeline run
 
     class _RecordingConsumer(BufferConsumer):
         async def consume_buffer(self, buf, executor=None):
             events.append("B consumed")
 
         def get_consuming_cost_bytes(self) -> int:
-            return 100
+            return 150
 
     async def _run():
         storage = MemoryStoragePlugin()
@@ -197,7 +205,10 @@ def test_deferred_cost_held_until_release():
             ReadReq(path="c", buffer_consumer=_GatedConsumer(gate)),
             ReadReq(path="b", buffer_consumer=_RecordingConsumer()),
         ]
-        await execute_read_reqs(reqs, storage, memory_budget_bytes=200, rank=0)
+        # Budget admits A+C (300) but not B (needs 150 more); A's
+        # consume refunds nothing (fully deferred), C's never refunds —
+        # only A's explicit release can admit B.
+        await execute_read_reqs(reqs, storage, memory_budget_bytes=350, rank=0)
 
     asyncio.run(_run())
     assert "released" in events and "B consumed" in events

@@ -1,0 +1,290 @@
+"""Cross-object streaming restore + HBM admission control
+(VERDICT r4 #2/#4).
+
+Streaming used to engage only when ONE stored object exactly covered one
+single-device region; format-chunked dense arrays made the dominant
+restore shape "several whole chunks tiling one region", which fell back
+to host reassembly and serialized H2D behind storage reads. Streaming is
+now decided per REGION: every chunk that is a contiguous byte run of the
+region's flat layout deposits its sub-ranges on device as they land,
+and finalize concatenates in flat-offset order.
+
+The device-side budget mirrors the host budget: consume dispatch is
+gated on in-flight streamed bytes, released when assembly frees the
+chunks (SURVEY §7 hard-part 5 — the restore-side HBM story the take
+side's clone-OOM fallback never covered).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchsnapshot_tpu.io_preparer as iop
+import torchsnapshot_tpu.scheduler as sched
+from torchsnapshot_tpu import Snapshot
+from torchsnapshot_tpu.io_types import BufferConsumer, IOReq, ReadReq
+from torchsnapshot_tpu.scheduler import execute_read_reqs
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+@pytest.fixture
+def small_scale(monkeypatch):
+    """1 MiB format chunks, 256 KiB sub-reads: a few-MiB array walks the
+    same chunked-streaming machinery a multi-GiB param hits at the
+    512 MiB / 64 MiB defaults."""
+    monkeypatch.setattr(iop, "MAX_CHUNK_SIZE_BYTES", 1 << 20)
+    monkeypatch.setenv("TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", str(256 << 10))
+
+
+def _arr(nbytes, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(nbytes // 4), jnp.float32)
+
+
+def test_chunked_dense_restore_streams_across_objects(
+    tmp_path, small_scale, monkeypatch
+):
+    """Every chunk object of a format-chunked dense array must stream to
+    device as its sub-ranges land (no host assembly buffer), and the
+    flat-offset concat must be bit-exact."""
+    puts = []
+    real_put = iop.chunked_device_put
+
+    def _spy_put(host, device):
+        puts.append(int(getattr(host, "nbytes", 0)))
+        return real_put(host, device)
+
+    monkeypatch.setattr(iop, "chunked_device_put", _spy_put)
+
+    arr = _arr(4 << 20, seed=1)  # 4 chunks x 4 sub-reads
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )
+    # All bytes arrived via streamed sub-range puts (16 x 256 KiB), not
+    # one whole-region device_put at finalize.
+    assert sum(puts) == arr.nbytes
+    assert len(puts) >= 8
+
+
+def test_streaming_restore_respects_device_budget(
+    tmp_path, small_scale, monkeypatch
+):
+    """With a forced device budget smaller than the combined streamed
+    chunks, in-flight deposited bytes must never exceed budget by more
+    than the single force-admitted consume, and every deposited byte
+    must be released back by assembly."""
+    cells = []
+
+    class _SpyCell(sched._BudgetCell):
+        def __init__(self, value):
+            super().__init__(value)
+            self.initial = value
+            self.min_seen = value
+            cells.append(self)
+
+        def charge(self, nbytes):
+            super().charge(nbytes)
+            self.min_seen = min(self.min_seen, self.value)
+
+    monkeypatch.setattr(sched, "_BudgetCell", _SpyCell)
+    # Each 3 MiB region charges 2x its size up front (deposits + concat
+    # transient) and keeps the resident half charged after assembly. A
+    # 9 MiB budget admits region A (charge 6), blocks B until A's
+    # transient release (+3 -> 6 free) — concurrent admission would
+    # have driven the cell to 9-12 = -3.
+    region = 3 << 20
+    budget = 9 << 20
+    monkeypatch.setenv("TPUSNAPSHOT_DEVICE_BUDGET_BYTES", str(budget))
+
+    a = _arr(region, seed=2)
+    b = _arr(region, seed=3)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"a": a, "b": b})})
+    target = {"m": _Holder({"a": jnp.zeros_like(a), "b": jnp.zeros_like(b)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["a"]), np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["b"]), np.asarray(b))
+
+    device_cells = [c for c in cells if c.initial == budget]
+    assert device_cells, "device budget cell was never constructed"
+    for cell in device_cells:
+        # Up-front charging + serialized admission: the cell never goes
+        # negative when each region's 2x charge fits the budget.
+        assert cell.min_seen >= 0
+        # The budget was actually contended (at least one region held).
+        assert cell.min_seen <= budget - 2 * region
+        # Only the transient halves returned; the restored arrays'
+        # resident bytes stay charged.
+        assert cell.value == cell.initial - 2 * region
+
+
+def test_streaming_restore_force_admit_bounded_by_one_region(
+    tmp_path, small_scale, monkeypatch
+):
+    """A region BIGGER than the whole device budget still restores
+    (force-admitted when nothing in flight can release), and the overrun
+    is bounded by that single region's size."""
+    cells = []
+
+    class _SpyCell(sched._BudgetCell):
+        def __init__(self, value):
+            super().__init__(value)
+            self.initial = value
+            self.min_seen = value
+            cells.append(self)
+
+        def charge(self, nbytes):
+            super().charge(nbytes)
+            self.min_seen = min(self.min_seen, self.value)
+
+    monkeypatch.setattr(sched, "_BudgetCell", _SpyCell)
+    region = 3 << 20
+    budget = 4 << 20  # smaller than one region's 2x charge
+    monkeypatch.setenv("TPUSNAPSHOT_DEVICE_BUDGET_BYTES", str(budget))
+
+    a = _arr(region, seed=6)
+    b = _arr(region, seed=7)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"a": a, "b": b})})
+    target = {"m": _Holder({"a": jnp.zeros_like(a), "b": jnp.zeros_like(b)})}
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["a"]), np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(target["m"].sd["b"]), np.asarray(b))
+
+    device_cells = [c for c in cells if c.initial == budget]
+    assert device_cells
+    for cell in device_cells:
+        # Overrun bounded to ONE region's 2x charge at a time (plus the
+        # prior region's resident half) — never both transients:
+        # worst = budget - 2*region (A forced) - region (A resident).
+        assert cell.min_seen >= budget - 3 * region
+        assert cell.value == cell.initial - 2 * region
+
+
+def test_streaming_skipped_for_resharded_templates(tmp_path, small_scale):
+    """A chunk overlapping TWO regions (resharded restore) must fall
+    back to the host-scatter path for that region — and still be
+    bit-exact."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    arr = _arr(4 << 20, seed=4)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    target = {
+        "m": _Holder(
+            {"w": jax.device_put(jnp.zeros_like(arr), NamedSharding(mesh, P("x")))}
+        )
+    }
+    Snapshot(path).restore(target)
+    np.testing.assert_array_equal(
+        np.asarray(target["m"].sd["w"]), np.asarray(arr)
+    )
+
+
+def test_streaming_detects_corrupt_chunk(tmp_path, small_scale):
+    """Per-chunk incremental crc still gates exposure: corrupting ONE
+    chunk object fails the restore."""
+    arr = _arr(4 << 20, seed=5)
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": _Holder({"w": arr})})
+    entry = Snapshot(path).get_manifest()["0/m/w"]
+    victim = tmp_path / "snap" / entry.shards[2].array.location
+    raw = bytearray(victim.read_bytes())
+    raw[1000] ^= 0x55
+    victim.write_bytes(bytes(raw))
+    target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+    with pytest.raises(RuntimeError, match="[Cc]hecksum"):
+        Snapshot(path).restore(target)
+
+
+def test_scheduler_device_budget_gates_consume_dispatch():
+    """Unit: a consume with device cost is not dispatched while the
+    device budget is exhausted and another consume is in flight; the
+    releaser re-admits it."""
+    events = []
+
+    class _DevConsumer(BufferConsumer):
+        def __init__(self, name, dcost, hold_s=0.0, release_after=None):
+            self.name = name
+            self.dcost = dcost
+            self.hold_s = hold_s
+            self.release_after = release_after
+            self._release = None
+
+        async def consume_buffer(self, buf, executor=None):
+            events.append(f"start {self.name}")
+            if self.hold_s:
+                await asyncio.sleep(self.hold_s)
+            if self.release_after is not None:
+                self._release(self.release_after)
+                events.append(f"release {self.name}")
+            events.append(f"end {self.name}")
+
+        def get_consuming_cost_bytes(self):
+            return 1
+
+        def get_device_cost_bytes(self):
+            return self.dcost
+
+        def set_device_cost_releaser(self, release):
+            self._release = release
+
+    class _OrderedStorage(MemoryStoragePlugin):
+        # Deterministic read-completion order: a first (so its consume
+        # holds the budget), then c, then b.
+        _delays = {"a": 0.0, "c": 0.01, "b": 0.02}
+
+        async def read(self, io_req):
+            await asyncio.sleep(self._delays.get(io_req.path, 0.0))
+            await super().read(io_req)
+
+    async def _run():
+        storage = _OrderedStorage()
+        for p in ("a", "b", "c"):
+            await storage.write(IOReq(path=p, data=b"x"))
+        reqs = [
+            # A: takes 80 of 100, holds it briefly then releases.
+            ReadReq(
+                path="a",
+                buffer_consumer=_DevConsumer(
+                    "A", dcost=80, hold_s=0.05, release_after=80
+                ),
+            ),
+            # B: needs 50 — must wait for A's release.
+            ReadReq(path="b", buffer_consumer=_DevConsumer("B", dcost=50)),
+            # C: no device cost — dispatches freely.
+            ReadReq(path="c", buffer_consumer=_DevConsumer("C", dcost=0)),
+        ]
+        await execute_read_reqs(
+            reqs,
+            storage,
+            memory_budget_bytes=1 << 20,
+            rank=0,
+            device_budget_bytes=100,
+        )
+
+    asyncio.run(_run())
+    # B waited for A's release; C (no device cost) skipped past the
+    # blocked B instead of head-of-line blocking behind it.
+    assert events.index("release A") < events.index("start B")
+    assert events.index("start C") < events.index("start B")

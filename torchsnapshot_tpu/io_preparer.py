@@ -103,6 +103,37 @@ def _parallel_read_threshold() -> int:
         _PARALLEL_READ_THRESHOLD_ENV_VAR, _DEFAULT_PARALLEL_READ_THRESHOLD
     )
 
+
+_DEVICE_BUDGET_ENV_VAR = "TPUSNAPSHOT_DEVICE_BUDGET_BYTES"
+
+
+def get_device_restore_budget_bytes() -> Optional[int]:
+    """HBM bytes the restore pipeline may hold as in-flight streamed
+    chunks awaiting assembly (SURVEY §7 hard-part 5). Explicit env knob
+    wins (0 = unbounded); otherwise 90% of the device's currently free
+    memory when the runtime reports it (TPUs do; CPU/virtual devices
+    usually return None → unbounded)."""
+    raw = os.environ.get(_DEVICE_BUDGET_ENV_VAR)
+    if raw is not None:
+        # Sentinel default: a malformed value falls THROUGH to the
+        # autodetect below (r5 review finding — mapping it to
+        # "unbounded" would strip exactly the protection the operator
+        # explicitly asked for). An explicit 0 means unbounded.
+        value = env_int(_DEVICE_BUDGET_ENV_VAR, -1)
+        if value > 0:
+            return value
+        if value == 0:
+            return None
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if limit:
+            return max(int(0.9 * (limit - in_use)), 256 * 1024 * 1024)
+    except Exception:
+        pass
+    return None
+
 _PRIMITIVE_TYPES = (int, float, bool, str, complex, type(None))
 
 
@@ -365,11 +396,23 @@ class _TargetRegion:
         self.offsets = offsets
         self.sizes = sizes
         self.devices: List[Any] = []
+        self.nbytes = int(np.dtype(dtype).itemsize * np.prod(sizes))
         self.buffer = np.empty(sizes, dtype=dtype)
-        # Streaming split reads leave the region's data on device as an
-        # ordered list of 1-D chunks (finalize concatenates + reshapes
-        # on device instead of device_put-ing ``buffer``).
-        self.device_chunks: Optional[List[Any]] = None
+        # Whether the scheduler's device budget already holds this
+        # region's reservation (charged once, by the first admitted
+        # streaming sub-read; the unit of HBM occupancy is the region —
+        # its chunks stay deposited until assembly).
+        self.device_charged = False
+        # Streaming reads leave the region's data on device as 1-D
+        # chunks keyed by their flat byte offset within the region
+        # (finalize concatenates + reshapes on device instead of a host
+        # device_put). Distinct keys, so concurrent chunk streams
+        # deposit without a region lock (GIL-atomic dict writes).
+        self.device_chunks: Optional[Dict[int, Any]] = None
+        # (release_cb, nbytes) pairs invoked by finalize once the
+        # deposited chunks are concatenated and freed — returns the
+        # streamed bytes to the scheduler's device-memory budget.
+        self.device_releases: List[Tuple[Callable[[int], None], int]] = []
 
 
 class _ChunkCopyConsumer(BufferConsumer):
@@ -562,6 +605,7 @@ class _StreamingSplitState(_SplitObjectReadState):
         dtype: np.dtype,
         checksum: Optional[str],
         on_done: Callable[[], None],
+        flat_base: int = 0,
     ) -> None:
         super().__init__(nbytes, inner=None)  # inner unused
         self._region = region
@@ -569,7 +613,14 @@ class _StreamingSplitState(_SplitObjectReadState):
         self._checksum = checksum
         self._on_done = on_done
         self._device = region.devices[0]
-        self._dev_chunks: Dict[int, Any] = {}  # start offset -> 1-D array
+        # Byte offset of this stored object within the region's flat
+        # layout: format-chunked dense arrays stream SEVERAL objects
+        # into one region, each depositing at flat_base + sub-offset
+        # (VERDICT r4 #2 — streaming used to engage only when one object
+        # exactly covered the region).
+        self._flat_base = flat_base
+        if region.device_chunks is None:
+            region.device_chunks = {}
         # Incremental crc (same no-op contract as verify_checksum for
         # absent/unknown-algorithm checksums).
         self._crc: Optional[StreamingCrc32] = (
@@ -580,6 +631,15 @@ class _StreamingSplitState(_SplitObjectReadState):
         self._next_off = 0
         self._stash: Dict[int, BufferType] = {}
         self._released = 0  # deferred bytes already re-credited
+        self._device_release: Optional[Callable[[int], None]] = None
+        self._deposited = 0  # device bytes charged by the scheduler
+
+    def set_device_cost_releaser(self, release: Callable[[int], None]) -> None:
+        self._device_release = release
+
+    def note_device_cost(self, nbytes: int) -> None:
+        with self._lock:
+            self._deposited += nbytes
 
     def extra_first_cost_bytes(self) -> int:
         # No host assembly buffer: parts go straight to device. Charging
@@ -647,8 +707,13 @@ class _StreamingSplitState(_SplitObjectReadState):
             dev = await loop.run_in_executor(executor, _consume_part)
         else:
             dev = _consume_part()
+        # Deposit straight into the region, keyed by region-flat byte
+        # offset (distinct keys across all of the region's chunk
+        # streams; GIL-atomic dict write). The chunks stay unreachable
+        # to the application until the plan's finalize assembles them —
+        # which only runs after every chunk's crc verified.
+        self._region.device_chunks[self._flat_base + start] = dev
         with self._lock:
-            self._dev_chunks[start] = dev
             self._remaining -= 1
             last = self._remaining == 0
         if last:
@@ -660,13 +725,14 @@ class _StreamingSplitState(_SplitObjectReadState):
                             f"Checksum mismatch: stored object is corrupt "
                             f"(expected {self._checksum}, got {actual})."
                         )
-                self._region.device_chunks = [
-                    self._dev_chunks[s] for s in sorted(self._dev_chunks)
-                ]
-                # Drop our references: once finalize concatenates, the
-                # per-sub-range arrays must be collectable or the restored
-                # array's HBM footprint doubles until the read loop exits.
-                self._dev_chunks.clear()
+                # Hand the scheduler's device-budget reservation to the
+                # region: finalize releases it once the concat frees the
+                # per-chunk arrays.
+                if self._device_release is not None and self._deposited:
+                    self._region.device_releases.append(
+                        (self._device_release, self._deposited)
+                    )
+                    self._device_release = None
                 self._on_done()
             finally:
                 self._release_assembly_cost()
@@ -711,6 +777,47 @@ class _SubRangeConsumer(BufferConsumer):
 
     def set_cost_releaser(self, release: Callable[[int], None]) -> None:
         self._state.set_cost_releaser(release)
+
+    @property
+    def sort_key_bytes(self) -> int:
+        # Scheduler dispatch ordering: all of one object's sub-reads
+        # share the object's size, keeping the group contiguous under
+        # the largest-first stable sort (the first sub-read's consuming
+        # COST carries the assembly surcharge and must not be used as
+        # the ordering key).
+        return self._state.nbytes
+
+    def get_device_cost_bytes(self) -> int:
+        # Streaming sub-reads put their payload in device memory the
+        # moment they consume, and it stays there until the REGION
+        # assembles — so the whole region is charged up front by its
+        # first admitted sub-read (SURVEY §7 hard-part 5: the scheduler
+        # gates consume dispatch on a device-side budget; per-part
+        # charges could not hold it, since releases only arrive at
+        # region finalize). The charge is TWICE the region: deposited
+        # chunks + the concatenated result coexist during assembly, and
+        # after it the restored array stays RESIDENT — finalize releases
+        # only the transient half, so the budget keeps tracking
+        # cumulative HBM the restore now occupies (r5 review finding:
+        # recrediting the full region let admissions run ~2x past the
+        # free-HBM snapshot the budget came from). Sub-reads of an
+        # already-charged region cost 0 — completing a started region is
+        # always admissible, which is the progress property the
+        # pipeline needs.
+        if not isinstance(self._state, _StreamingSplitState):
+            return 0
+        region = self._state._region
+        return 0 if region.device_charged else 2 * region.nbytes
+
+    def set_device_cost_releaser(
+        self, release: Callable[[int], None]
+    ) -> None:
+        region = self._state._region
+        region.device_charged = True
+        self._state.set_device_cost_releaser(release)
+        # The transient half, returned by finalize once the concat's
+        # buffers settle; the resident half stays charged.
+        self._state.note_device_cost(region.nbytes)
 
 
 class ArrayRestorePlan:
@@ -812,19 +919,105 @@ class ArrayRestorePlan:
         n_logical = 0  # finalize triggers: one per chunk consumed
         split_threshold = _parallel_read_threshold()
         itemsize = np.dtype(self._dtype).itemsize
+        strict = os.environ.get("TPUSNAPSHOT_STRICT_INTEGRITY") == "1"
+
+        # Pass 1: overlaps of every chunk against every region.
+        planned = []  # (chunk fields..., copies)
         for chunk_off, chunk_sz, location, chunk_checksum, compression in self._chunks:
             copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Overlap]] = []
             for region in self._regions:
                 ov = compute_overlap(chunk_off, chunk_sz, region.offsets, region.sizes)
                 if ov is not None:
                     copies.append((region, ov.target_slices, ov))
-            if not copies:
+            if copies:
+                planned.append(
+                    (chunk_off, chunk_sz, location, chunk_checksum, compression, copies)
+                )
+
+        # Pass 2: pick the regions whose ENTIRE payload can stream to
+        # device as it lands (VERDICT r4 #2: streaming used to engage
+        # only when one object exactly covered one region; with
+        # format-chunked dense arrays the dominant shape is SEVERAL
+        # whole chunks tiling one single-device region, each chunk a
+        # contiguous byte run of the region's flat layout). Streaming is
+        # all-or-nothing per region — mixing streamed chunks with
+        # host-buffer chunks would need a partial host buffer AND a
+        # device concat for the same region.
+        stream_region: Dict[int, Dict[int, int]] = {}  # id(region) -> {id(ov): flat_base}
+        by_region: Dict[int, List] = {}
+        for item in planned:
+            for region, _, ov in item[5]:
+                by_region.setdefault(id(region), []).append((item, ov))
+        region_by_id = {id(r): r for r in self._regions}
+        for rid, items in by_region.items():
+            region = region_by_id[rid]
+            if not (self._template_is_jax and len(region.devices) == 1):
+                continue
+            total = sum(
+                _chunk_nbytes(it[1], itemsize) for it, _ in items
+            )
+            if total <= split_threshold:
+                # Small regions keep the batched-device_put path: one
+                # put per tiny shard beats many micro-streams.
+                continue
+            flat_bases: Dict[int, int] = {}
+            ok = True
+            for (chunk_off, chunk_sz, _, _, compression, copies), ov in items:
+                run = contiguous_byte_range(
+                    region.sizes, ov.target_slices, itemsize
+                )
+                if (
+                    compression is not None
+                    or len(copies) != 1
+                    or run is None
+                    or any(
+                        sl.start != 0 or sl.stop != dim
+                        for sl, dim in zip(ov.chunk_slices, chunk_sz)
+                    )
+                ):
+                    ok = False
+                    break
+                flat_bases[id(ov)] = run[0]
+            if ok:
+                stream_region[rid] = flat_bases
+                # The host-side region buffer is never touched on this
+                # path; drop it so a large restore does not hold an
+                # idle full-size host allocation.
+                region.buffer = None
+                region.device_chunks = {}
+
+        # Pass 3: emit read requests.
+        for chunk_off, chunk_sz, location, chunk_checksum, compression, copies in planned:
+            chunk_nbytes = _chunk_nbytes(chunk_sz, itemsize)
+            # Sub-range boundaries must land on element boundaries for
+            # streaming device chunks.
+            part = max(
+                itemsize, split_threshold - (split_threshold % itemsize)
+            )
+            if (
+                len(copies) == 1
+                and id(copies[0][0]) in stream_region
+            ):
+                # Whole chunk streams into its region at its flat
+                # offset, overlapping storage reads with H2D transfers.
+                # The crc verifies incrementally over the in-order byte
+                # stream — valid under TPUSNAPSHOT_STRICT_INTEGRITY.
+                region0, _, ov0 = copies[0]
+                stream = _StreamingSplitState(
+                    chunk_nbytes,
+                    region=region0,
+                    dtype=np.dtype(self._dtype),
+                    checksum=chunk_checksum,
+                    on_done=self._on_req_done,
+                    flat_base=stream_region[id(region0)][id(ov0)],
+                )
+                n_logical += 1
+                reqs.extend(stream.add_sub_reads(location, part))
                 continue
             ranges = [
                 contiguous_byte_range(chunk_sz, ov.chunk_slices, itemsize)
                 for _, _, ov in copies
             ]
-            chunk_nbytes = _chunk_nbytes(chunk_sz, itemsize)
             partial = len(copies) > 1 or (
                 ranges[0] is not None and (ranges[0][1] - ranges[0][0]) < chunk_nbytes
             )
@@ -833,7 +1026,6 @@ class ArrayRestorePlan:
             # reads also cannot verify the chunk's checksum (it covers the
             # whole stored object) — TPUSNAPSHOT_STRICT_INTEGRITY=1 trades
             # the ranged-read bandwidth savings for full verification.
-            strict = os.environ.get("TPUSNAPSHOT_STRICT_INTEGRITY") == "1"
             if (
                 compression is None
                 and not strict
@@ -898,52 +1090,13 @@ class ArrayRestorePlan:
                     # assembled payload, so this stays valid under
                     # TPUSNAPSHOT_STRICT_INTEGRITY. (Compressed objects
                     # can't split: their stored size is not derivable
-                    # from the manifest shape.)
-                    region0, region_slices0, ov0 = copies[0]
-                    streamable = (
-                        self._template_is_jax
-                        and len(copies) == 1
-                        and len(region0.devices) == 1
-                        and list(ov0.sizes) == list(chunk_sz)
-                        and list(chunk_sz) == list(region0.sizes)
-                        and all(
-                            sl.start == 0 and sl.stop == dim
-                            for sl, dim in zip(
-                                region_slices0, region0.sizes
-                            )
-                        )
-                        and all(
-                            sl.start == 0 and sl.stop == dim
-                            for sl, dim in zip(ov0.chunk_slices, chunk_sz)
-                        )
+                    # from the manifest shape. Streaming-to-device was
+                    # decided per-REGION in pass 2; chunks landing here
+                    # reassemble on host.)
+                    state = _SplitObjectReadState(
+                        chunk_nbytes, _whole_consumer()
                     )
-                    # Sub-range boundaries must land on element
-                    # boundaries for the streaming device chunks.
-                    part = max(
-                        itemsize,
-                        split_threshold - (split_threshold % itemsize),
-                    )
-                    if streamable:
-                        # Dominant shape (one big dense param, one
-                        # device): stream each sub-range to the device
-                        # as it lands, overlapping reads with H2D.
-                        stream = _StreamingSplitState(
-                            chunk_nbytes,
-                            region=region0,
-                            dtype=np.dtype(self._dtype),
-                            checksum=chunk_checksum,
-                            on_done=self._on_req_done,
-                        )
-                        # The host-side region buffer is never touched
-                        # on this path; drop it so a large restore does
-                        # not hold an idle full-size host allocation.
-                        region0.buffer = None
-                        reqs.extend(stream.add_sub_reads(location, part))
-                    else:
-                        state = _SplitObjectReadState(
-                            chunk_nbytes, _whole_consumer()
-                        )
-                        reqs.extend(state.add_sub_reads(location, part))
+                    reqs.extend(state.add_sub_reads(location, part))
                 else:
                     reqs.append(
                         ReadReq(
@@ -982,18 +1135,41 @@ class ArrayRestorePlan:
             for region in self._regions:
                 for device in region.devices:
                     if region.device_chunks is not None:
-                        # Streaming split read: the bytes are already on
-                        # device as ordered 1-D chunks — concatenate +
-                        # reshape there instead of a host device_put.
+                        # Streaming reads: the bytes are already on
+                        # device as 1-D chunks keyed by flat offset —
+                        # concatenate in offset order + reshape there
+                        # instead of a host device_put.
+                        ordered = [
+                            region.device_chunks[k]
+                            for k in sorted(region.device_chunks)
+                        ]
                         flat = (
-                            jnp.concatenate(region.device_chunks)
-                            if len(region.device_chunks) > 1
-                            else region.device_chunks[0]
+                            jnp.concatenate(ordered)
+                            if len(ordered) > 1
+                            else ordered[0]
                         )
-                        prebuilt[len(buffers)] = jnp.reshape(
-                            flat, tuple(region.sizes)
-                        )
+                        assembled = jnp.reshape(flat, tuple(region.sizes))
+                        prebuilt[len(buffers)] = assembled
+                        # Free the per-chunk arrays eagerly and return
+                        # the TRANSIENT half of the device reservation
+                        # (the assembled array's half stays charged — it
+                        # remains resident). Wait for the concat to
+                        # actually execute first: releasing at dispatch
+                        # time would re-admit new streams while chunks
+                        # and result still coexist.
                         region.device_chunks = None
+                        del flat, ordered
+                        if region.device_releases:
+                            try:
+                                assembled.block_until_ready()
+                            except Exception:
+                                pass
+                            releases, region.device_releases = (
+                                region.device_releases,
+                                [],
+                            )
+                            for cb, nbytes in releases:
+                                cb(nbytes)
                     buffers.append(region.buffer)
                     devices.append(device)
             chunk_mask = [
@@ -1118,15 +1294,23 @@ def _prepare_chunked_dense_write(
     (``<rank>/…`` / ``replicated/…``), so two ranks' same-named per-rank
     values can never collide on storage paths."""
     shape = list(arr.shape)
-    base = get_storage_path(rank, logical_path, replicated)
+    # Chunk objects live under their own top-level namespace
+    # ("chunked/<owner>/…"), disjoint from every dense leaf location
+    # ("<rank>/…", "replicated/…") — a leaf literally named
+    # "<path>_<offsets>" must never collide with a sibling's chunk
+    # (r5 review finding). The ordinal suffix "__chunk_<i>" is
+    # unambiguous by construction: every chunk location ends with it,
+    # and stripping the final suffix recovers the logical path even
+    # when another leaf's name embeds a chunk-like suffix.
+    owner = "replicated" if replicated else str(rank)
+    base = f"chunked/{owner}/{logical_path}"
     pieces = subdivide(
         [0] * len(shape), shape, dtype.itemsize, MAX_CHUNK_SIZE_BYTES
     )
     shards: List[Shard] = []
     reqs: List[WriteReq] = []
-    for c_off, c_sz in pieces:
-        suffix = "_".join(str(o) for o in c_off)
-        location = f"{base}_{suffix}"
+    for i, (c_off, c_sz) in enumerate(pieces):
+        location = f"{base}__chunk_{i}"
         chunk_entry = ArrayEntry(
             location=location,
             serializer=ARRAY_SERIALIZER,

@@ -204,6 +204,22 @@ class BufferConsumer(abc.ABC):
         consumer must invoke ``release(n)`` exactly once, when the
         deferred allocation is actually freed."""
 
+    def get_device_cost_bytes(self) -> int:
+        """Device (HBM) bytes this consume deposits that outlive the
+        consume call (streamed chunks awaiting assembly). The scheduler
+        gates consume DISPATCH on a device-side budget so concurrent
+        large restores cannot transiently exceed device memory. 0 for
+        consumers that stay on host."""
+        return 0
+
+    def set_device_cost_releaser(
+        self, release: Callable[[int], None]
+    ) -> None:
+        """Receive the device-budget release callback. Only called when
+        :meth:`get_device_cost_bytes` returns non-zero; the consumer (or
+        the assembly step it feeds) must invoke ``release(n)`` once the
+        deposited device bytes are freed."""
+
 
 @dataclass
 class WriteReq:

@@ -51,7 +51,12 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from . import tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .flatten import flatten, inflate
-from .io_preparer import device_clone_write_reqs, prepare_read, prepare_write
+from .io_preparer import (
+    device_clone_write_reqs,
+    get_device_restore_budget_bytes,
+    prepare_read,
+    prepare_write,
+)
 from .io_types import (
     IOReq,
     ReadReq,
@@ -1003,7 +1008,15 @@ class Snapshot:
                         f"rank {rank}; missing leaves: "
                         f"{', '.join(sorted(unresolved)[:10])}"
                     )
-                asyncio.run(execute_read_reqs(reqs, storage, budget, rank))
+                asyncio.run(
+                execute_read_reqs(
+                    reqs,
+                    storage,
+                    budget,
+                    rank,
+                    device_budget_bytes=get_device_restore_budget_bytes(),
+                )
+            )
                 for finalize in finalizers:
                     finalize()
                 return inflate(containers, flattened, prefix=logical_path)
@@ -1011,7 +1024,15 @@ class Snapshot:
             reqs, finalizers = prepare_read(
                 entry=entry, template=template, callback=lambda v: result.update(v=v)
             )
-            asyncio.run(execute_read_reqs(reqs, storage, budget, rank))
+            asyncio.run(
+                execute_read_reqs(
+                    reqs,
+                    storage,
+                    budget,
+                    rank,
+                    device_budget_bytes=get_device_restore_budget_bytes(),
+                )
+            )
             for finalize in finalizers:
                 finalize()
             return result["v"]
@@ -1647,7 +1668,15 @@ def _load_stateful(
         read_reqs.extend(reqs)
         finalizers.extend(fins)
 
-    asyncio.run(execute_read_reqs(read_reqs, storage, budget, rank))
+    asyncio.run(
+        execute_read_reqs(
+            read_reqs,
+            storage,
+            budget,
+            rank,
+            device_budget_bytes=get_device_restore_budget_bytes(),
+        )
+    )
     for finalize in finalizers:
         finalize()
 
