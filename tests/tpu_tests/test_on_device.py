@@ -210,3 +210,33 @@ def test_flash_gqa_on_device():
     assert grads[1].shape == (b, hkv, s, d)
     for gr in grads:
         assert bool(jnp.isfinite(gr.astype(jnp.float32)).all())
+
+
+def test_streaming_restore_device_budget_on_device(tmp_path, monkeypatch):
+    """HBM admission control on the real chip (SURVEY §7 hard-part 5):
+    two arrays whose combined streamed chunks exceed a forced device
+    budget restore bit-exactly — regions admitted one at a time against
+    the budget, with the resident halves staying charged. Payload is
+    tunnel-sized (~128 MiB); the budget forces the same contention a
+    near-HBM-capacity restore hits at full scale."""
+    import torchsnapshot_tpu.io_preparer as iop
+
+    monkeypatch.setattr(iop, "MAX_CHUNK_SIZE_BYTES", 16 << 20)
+    monkeypatch.setenv(
+        "TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", str(4 << 20)
+    )
+    # Each 64 MiB region charges 2x its size; 160 MiB admits one region
+    # (128 MiB charge) but never both at once.
+    monkeypatch.setenv(
+        "TPUSNAPSHOT_DEVICE_BUDGET_BYTES", str(160 << 20)
+    )
+    a = jax.random.normal(jax.random.key(11), (16 << 20,), jnp.float32)
+    b = jax.random.normal(jax.random.key(12), (16 << 20,), jnp.float32)
+    jax.block_until_ready((a, b))
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"s": StateDict(a=a, b=b)})
+    target = StateDict(a=jnp.zeros_like(a), b=jnp.zeros_like(b))
+    Snapshot(path).restore({"s": target})
+    eq = jax.jit(lambda x, y: jnp.all(x == y))
+    assert bool(eq(target["a"], a)) and bool(eq(target["b"], b))
+    assert next(iter(target["a"].devices())).platform != "cpu"
