@@ -1,0 +1,486 @@
+"""Incremental (deduplicated) snapshots — beyond reference parity.
+
+``Snapshot.take(..., base=prev)`` skips the device→host transfer and the
+storage write for arrays whose device-computed content fingerprint
+matches what ``prev`` recorded; the manifest references the base's
+objects instead (``@base<N>/…`` via storage_plugin.RefRouterPlugin).
+See torchsnapshot_tpu/incremental.py for the safety model under test:
+misses degrade to full writes, hits require fingerprint+checksum+
+shape/dtype/region equality, chains flatten, back-link markers guard
+base deletion.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+from torchsnapshot_tpu.manifest import ArrayEntry, ShardedArrayEntry
+
+
+def _count_payload_files(root: str) -> int:
+    """Stored objects under a snapshot dir, excluding metadata/markers."""
+    n = 0
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            rel = os.path.relpath(os.path.join(dirpath, f), root)
+            if rel == ".snapshot_metadata" or rel.startswith(
+                (".completed", "refs")
+            ):
+                continue
+            n += 1
+    return n
+
+
+def _state(seed=0, n=1024):
+    rng = np.random.RandomState(seed)
+    return StateDict(
+        w=jnp.asarray(rng.randn(n).astype(np.float32)),
+        b=rng.randn(32).astype(np.float32),  # host numpy leaf
+        step=7,
+    )
+
+
+def test_unchanged_take_writes_no_array_objects(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    assert _count_payload_files(str(tmp_path / "s2")) == 0
+    m = s2.get_manifest()
+    assert m["0/model/w"].base is not None
+    assert m["0/model/b"].base is not None  # host leaf dedups too
+    # restore is bit-exact through the reference
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s2.restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    assert np.array_equal(fresh["model"]["b"], app["model"]["b"])
+    assert fresh["model"]["step"] == 7
+    assert s2.verify() == {}
+
+
+def test_changed_subset_writes_only_changed(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    app["model"]["b"] = app["model"]["b"] + 1.0
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    m = s2.get_manifest()
+    assert m["0/model/w"].base is not None  # unchanged: ref
+    assert m["0/model/b"].base is None  # changed: written
+    assert _count_payload_files(str(tmp_path / "s2")) == 1
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s2.restore(fresh)
+    assert np.array_equal(fresh["model"]["b"], app["model"]["b"])
+    assert s2.verify() == {}
+
+
+def test_chain_flattens_to_original_writer(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2)
+    meta = s3._read_snapshot_metadata(s3._open_storage())
+    # w was PHYSICALLY written by s1; s3 must reference s1 directly even
+    # though its base argument was s2 (chains never deepen).
+    w = meta.manifest["0/model/w"]
+    idx = w.base
+    assert meta.base_paths[idx] == "rel:s1"
+    # s3 restores bit-exact even if the INTERMEDIATE s2 is deleted
+    s2_handle = Snapshot(str(tmp_path / "s2"))
+    s2_handle.delete()
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    Snapshot(str(tmp_path / "s3")).restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+
+
+def test_sharded_partial_region_dedup(tmp_path):
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]).reshape(8), ("dp",))
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp")
+    )
+    x = jax.device_put(
+        np.arange(8 * 64, dtype=np.float32).reshape(8, 64), sharding
+    )
+    app = {"model": StateDict(emb=x)}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    # touch ONE shard's rows
+    host = np.asarray(x).copy()
+    host[3] += 1.0
+    app["model"]["emb"] = jax.device_put(host, sharding)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    entry = s2.get_manifest()["0/model/emb"]
+    assert isinstance(entry, ShardedArrayEntry)
+    refs = [s for s in entry.shards if s.array.base is not None]
+    writes = [s for s in entry.shards if s.array.base is None]
+    assert len(refs) == 7 and len(writes) == 1
+    assert writes[0].offsets == [3, 0]
+    fresh = {"model": StateDict(emb=jax.device_put(
+        np.zeros((8, 64), np.float32), sharding))}
+    s2.restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["emb"]), host)
+    assert s2.verify() == {}
+
+
+def test_chunked_dense_dedup(tmp_path, monkeypatch):
+    import torchsnapshot_tpu.io_preparer as iop
+
+    monkeypatch.setattr(iop, "MAX_CHUNK_SIZE_BYTES", 1 << 12)
+    big = np.arange(4096, dtype=np.float32)  # 16 KiB -> 4 chunks
+    app = {"model": StateDict(big=jnp.asarray(big))}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    big2 = big.copy()
+    big2[0] += 1.0  # dirty only the first chunk
+    app["model"]["big"] = jnp.asarray(big2)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    entry = s2.get_manifest()["0/model/big"]
+    refs = [s for s in entry.shards if s.array.base is not None]
+    writes = [s for s in entry.shards if s.array.base is None]
+    assert len(writes) == 1 and writes[0].offsets == [0]
+    assert len(refs) == len(entry.shards) - 1
+    fresh = {"model": StateDict(big=jnp.zeros(4096, jnp.float32))}
+    s2.restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["big"]), big2)
+    assert s2.verify() == {}
+
+
+def _run_world(world, fn):
+    store = DictStore()
+    errors, results = [], [None] * world
+
+    def worker(rank):
+        try:
+            coord = StoreCoordinator(store, rank, world, timeout_s=60)
+            results[rank] = fn(coord, rank)
+        except BaseException as e:  # pragma: no cover
+            import traceback
+
+            errors.append((rank, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed:\n{errors[0][2]}")
+    return results
+
+
+def test_replicated_striping_dedup_world2(tmp_path):
+    """Replicated leaves: only the stripe owner evaluates the dedup, and
+    the merged manifest serves the referencing entry to every rank —
+    verify()/copy_to() must treat the snapshot as healthy even though
+    non-owner mirrors were never rewritten."""
+    base_path = str(tmp_path / "s1")
+    inc_path = str(tmp_path / "s2")
+
+    def take_base(coord, rank):
+        app = {"model": StateDict(
+            foo=jnp.asarray(np.full(256, 1.0, np.float32)),
+            bar=jnp.asarray(np.full(128, 2.0, np.float32)),
+        )}
+        Snapshot.take(base_path, app, coord=coord,
+                      replicated=["**"], fingerprint=True)
+
+    def take_inc(coord, rank):
+        app = {"model": StateDict(
+            foo=jnp.asarray(np.full(256, 1.0, np.float32)),
+            bar=jnp.asarray(np.full(128, 3.0, np.float32)),  # changed
+        )}
+        Snapshot.take(inc_path, app, coord=coord,
+                      replicated=["**"], base=base_path)
+
+    _run_world(2, take_base)
+    _run_world(2, take_inc)
+    s2 = Snapshot(inc_path)
+    assert s2.verify() == {}
+    # both ranks can restore the referencing entry
+    def restore(coord, rank):
+        fresh = {"model": StateDict(
+            foo=jnp.zeros(256, jnp.float32), bar=jnp.zeros(128, jnp.float32)
+        )}
+        Snapshot(inc_path).restore(fresh, coord=coord)
+        assert np.allclose(np.asarray(fresh["model"]["foo"]), 1.0)
+        assert np.allclose(np.asarray(fresh["model"]["bar"]), 3.0)
+
+    _run_world(2, restore)
+    # copy_to materializes a self-contained snapshot
+    flat = s2.copy_to(str(tmp_path / "flat"))
+    assert flat.verify() == {}
+    meta = flat._read_snapshot_metadata(flat._open_storage())
+    assert meta.base_paths == []
+    # only the changed replicated leaf was stored in s2's own root
+    assert _count_payload_files(inc_path) == 1
+
+
+def test_delete_protection_lifecycle(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    with pytest.raises(RuntimeError, match="referenced by"):
+        Snapshot(str(tmp_path / "s1")).delete()
+    # the child keeps working, then unblocks the base
+    Snapshot(str(tmp_path / "s2")).delete()
+    Snapshot(str(tmp_path / "s1")).delete()
+    assert _count_payload_files(str(tmp_path / "s1")) == 0
+    for root, _, files in os.walk(tmp_path):
+        assert not files, (root, files)
+
+
+def test_delete_force_overrides_protection(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    Snapshot(str(tmp_path / "s1")).delete(force=True)
+    # the child is now broken (documented force semantics)
+    fresh = {"model": _state(seed=9)}
+    with pytest.raises(Exception):
+        Snapshot(str(tmp_path / "s2")).restore(fresh)
+
+
+def test_young_orphan_marker_blocks_delete(tmp_path, monkeypatch):
+    """A back-link marker with no committed child metadata is an
+    IN-FLIGHT take if young: delete must fail closed (the marker lands
+    before the child's payload writes)."""
+    app = {"model": _state()}
+    Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    refs_dir = tmp_path / "s1" / "refs"
+    refs_dir.mkdir()
+    (refs_dir / "inc_deadbeef_0").write_text(
+        json.dumps({"path": "rel:s_inflight"})
+    )
+    with pytest.raises(RuntimeError, match="referenced by"):
+        Snapshot(str(tmp_path / "s1")).delete()
+    # the sweep knob must NOT disable this guard (separate knobs)
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    with pytest.raises(RuntimeError, match="referenced by"):
+        Snapshot(str(tmp_path / "s1")).delete()
+    # old marker (or the refs escape hatch) sweeps as stale
+    monkeypatch.setenv("TPUSNAPSHOT_REFS_MIN_AGE_S", "0")
+    Snapshot(str(tmp_path / "s1")).delete()
+
+
+def test_copy_to_survives_base_deletion(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    flat = s2.copy_to(str(tmp_path / "flat"))
+    Snapshot(str(tmp_path / "s2")).delete()
+    Snapshot(str(tmp_path / "s1")).delete()
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    Snapshot(str(tmp_path / "flat")).restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    assert flat.verify() == {}
+
+
+def test_verify_detects_corrupt_base_object(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    # flip a byte in the BASE's stored object
+    target = tmp_path / "s1" / "0" / "model" / "w"
+    raw = bytearray(target.read_bytes())
+    raw[10] ^= 0xFF
+    target.write_bytes(bytes(raw))
+    problems = s2.verify()
+    assert any("0/model/w" in loc for loc in problems), problems
+
+
+def test_base_without_fingerprints_degrades_to_full_write(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=False)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    m = s2.get_manifest()
+    assert m["0/model/w"].base is None  # no base fingerprint -> full write
+    assert _count_payload_files(str(tmp_path / "s2")) == 2  # w and b (step inlines)
+    assert s2.verify() == {}
+    # ...but s2 recorded fingerprints, so s3 CAN dedup against s2
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2)
+    assert _count_payload_files(str(tmp_path / "s3")) == 0
+
+
+def test_async_take_with_base(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    app["model"]["b"] = app["model"]["b"] + 5.0
+    pending = Snapshot.async_take(str(tmp_path / "s2"), app, base=s1)
+    s2 = pending.wait()
+    assert _count_payload_files(str(tmp_path / "s2")) == 1
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s2.restore(fresh)
+    assert np.array_equal(fresh["model"]["b"], app["model"]["b"])
+    assert s2.verify() == {}
+
+
+def test_moved_family_rel_refs(tmp_path):
+    src_dir = tmp_path / "ckpts"
+    src_dir.mkdir()
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(src_dir / "s1"), app, fingerprint=True)
+    Snapshot.take(str(src_dir / "s2"), app, base=s1)
+    moved = tmp_path / "archive"
+    shutil.move(str(src_dir), str(moved))
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    Snapshot(str(moved / "s2")).restore(fresh)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    assert Snapshot(str(moved / "s2")).verify() == {}
+
+
+def test_paths_filter_restore_with_refs(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s2.restore(fresh, paths=["model/w"])
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    assert np.array_equal(fresh["model"]["b"], np.zeros(32, np.float32))
+
+
+def test_read_object_through_refs(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    w = s2.read_object("model/w")
+    assert np.array_equal(np.asarray(w), np.asarray(app["model"]["w"]))
+
+
+def test_base_unreadable_raises(tmp_path):
+    app = {"model": _state()}
+    with pytest.raises(ValueError, match="unreadable"):
+        Snapshot.take(
+            str(tmp_path / "s2"), app, base=str(tmp_path / "nonexistent")
+        )
+
+
+def test_base_equals_path_raises(tmp_path):
+    app = {"model": _state()}
+    with pytest.raises(ValueError, match="NEW path"):
+        Snapshot.take(str(tmp_path / "s1"), app, base=str(tmp_path / "s1"))
+
+
+def test_fingerprint_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_FINGERPRINT", "1")
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app)
+    entry = s1.get_manifest()["0/model/w"]
+    assert entry.fingerprint is not None
+    assert entry.fingerprint.startswith("xs128:")
+
+
+def test_fingerprints_off_by_default(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app)
+    assert s1.get_manifest()["0/model/w"].fingerprint is None
+
+
+def test_object_entries_not_deduped(tmp_path):
+    """Pickled-object leaves are v1 out of scope: written every take."""
+    app = {"model": StateDict(w=jnp.arange(16, dtype=jnp.float32),
+                              cfg={"tags": {"adam", "fp32"}})}  # set pickles
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    m = s2.get_manifest()
+    assert m["0/model/w"].base is not None
+    # objects carry no ref machinery at all: always written
+    assert getattr(m["0/model/cfg/tags"], "base", None) is None
+    fresh = {"model": StateDict(w=jnp.zeros(16, jnp.float32),
+                                cfg={"tags": set()})}
+    s2.restore(fresh)
+    assert fresh["model"]["cfg"]["tags"] == {"adam", "fp32"}
+
+
+def test_dtype_or_shape_change_degrades_to_full_write(tmp_path):
+    app = {"model": StateDict(w=jnp.arange(64, dtype=jnp.float32))}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    app["model"]["w"] = jnp.arange(64, dtype=jnp.bfloat16)  # dtype change
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    assert s2.get_manifest()["0/model/w"].base is None
+    app["model"]["w"] = jnp.arange(128, dtype=jnp.bfloat16)  # shape change
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2)
+    assert s3.get_manifest()["0/model/w"].base is None
+
+
+def test_fingerprint_false_with_base_still_dedups_without_recording(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1, fingerprint=False)
+    m = s2.get_manifest()
+    assert m["0/model/w"].base is not None  # dedup still happened...
+    assert m["0/model/w"].fingerprint is None  # ...but nothing recorded
+    assert _count_payload_files(str(tmp_path / "s2")) == 0
+
+
+def test_decorated_handle_cache_reused_as_base(tmp_path):
+    """Using a handle whose metadata cache was DECORATED (by a prior
+    restore) as the next take's base must still produce bare locations
+    in the new snapshot's references."""
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    # force-decorate s2's cache the way a restore would
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s2.restore(fresh)
+    assert s2._metadata_cache is not None
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2)
+    meta3 = s3._read_snapshot_metadata(s3._open_storage())
+    for key in ("0/model/w", "0/model/b"):
+        e = meta3.manifest[key]
+        assert e.base is not None
+        # decorated exactly once (single @base prefix), resolving to s1
+        assert e.location.count("@base") == 1
+        assert meta3.base_paths[e.base] == "rel:s1"
+    fresh2 = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                 b=np.zeros(32, np.float32), step=0)}
+    s3.restore(fresh2)
+    assert np.array_equal(np.asarray(fresh2["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    assert s3.verify() == {}
+
+
+def test_backlink_markers_idempotent_across_takes(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    Snapshot.take(str(tmp_path / "s3"), app, base=s1)
+    markers = sorted(os.listdir(tmp_path / "s1" / "refs"))
+    # one marker per referencing snapshot, not per take attempt/rank
+    assert len(markers) == 2, markers
+
+
+def test_rng_state_flows_through_incremental(tmp_path):
+    from torchsnapshot_tpu import RNGState
+
+    np.random.seed(3)
+    app = {"rng": RNGState(), "model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    expected = np.random.rand()
+    np.random.seed(99)
+    fresh = {"rng": RNGState(), "model": _state(seed=5)}
+    s2.restore(fresh)
+    # np RNG stream restored: the next draw matches the original stream
+    assert np.random.rand() == expected
+    assert s2.verify() == {}

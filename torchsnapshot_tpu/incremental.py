@@ -1,0 +1,423 @@
+"""Incremental (deduplicated) snapshot takes.
+
+Beyond reference parity: torchsnapshot rewrites every tensor on every
+``Snapshot.take``. Here, ``Snapshot.take(path, app_state, base=prev)``
+fingerprints each array **on device** (fingerprint.py) and, when a
+leaf's content matches what ``prev`` recorded, skips BOTH the
+device→host transfer and the storage write — the manifest entry instead
+references the base snapshot's stored object (``@base<N>/…`` routing,
+storage_plugin.RefRouterPlugin). Take cost becomes proportional to
+*changed* bytes: checkpointing a LoRA fine-tune whose backbone is
+frozen, or an embedding model where only touched rows train, stops
+paying for the frozen majority.
+
+Safety model:
+
+- A fingerprint MISS (absent, algorithm drift, host↔device migration,
+  shape/dtype change) always degrades to a full write — never corrupt,
+  only less deduplication.
+- A dedup hit requires the base entry to carry BOTH a fingerprint and a
+  checksum, equal dtype/shape/prng_impl, and (for shards/chunks) equal
+  region coordinates.
+- Chains flatten: if the base entry itself references an older
+  snapshot, the new entry points directly at that original object, so
+  reference chains never deepen and every reference names the snapshot
+  that physically wrote the bytes.
+- Back-link markers (``refs/inc_<uuid>`` objects written into the base
+  root before this take commits) let ``Snapshot.delete`` on the base
+  discover referencing snapshots and refuse — see snapshot.py.
+"""
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .io_types import IOReq, StoragePlugin, WriteReq, io_payload
+from .manifest import (
+    ArrayEntry,
+    Entry,
+    Manifest,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_available_entries,
+)
+from .storage_plugin import (
+    encode_base_ref,
+    parse_ref_location,
+    resolve_base_ref,
+    url_to_storage_plugin,
+)
+
+logger = logging.getLogger(__name__)
+
+REFS_PREFIX = "refs/"
+
+
+@dataclass
+class IncrementalStats:
+    fingerprinted: int = 0
+    dedup_hits: int = 0
+    dedup_bytes: int = 0
+    written: int = 0
+
+
+@dataclass
+class _BaseContext:
+    base_path: str
+    metadata: SnapshotMetadata
+    available: Manifest
+    # Encoded refs for OUR metadata: [0] is the base itself, the rest are
+    # the base's own (transitive) bases re-encoded relative to us.
+    base_paths: List[str] = field(default_factory=list)
+    # base's base index -> our base_paths index (chain flattening).
+    idx_map: Dict[int, int] = field(default_factory=dict)
+
+
+def _read_metadata(base_path: str) -> SnapshotMetadata:
+    from .snapshot import _aread_metadata_at
+
+    return asyncio.run(_aread_metadata_at(base_path))
+
+
+def load_base_context(
+    base_path: str,
+    own_path: str,
+    rank: int,
+    metadata: Optional[SnapshotMetadata] = None,
+) -> _BaseContext:
+    """Read the base snapshot's metadata (or reuse a handle's cached
+    copy) and precompute the reference namespace for the new take.
+    Raises if the base is not a committed snapshot — an explicit
+    ``base=`` argument that cannot be honored is a configuration error,
+    not a soft miss."""
+    if metadata is None:
+        try:
+            metadata = _read_metadata(base_path)
+        except Exception as e:
+            raise ValueError(
+                f"base snapshot at {base_path!r} is unreadable ({e!r}); "
+                f"pass a committed snapshot (or None for a full take)"
+            ) from e
+    ctx = _BaseContext(
+        base_path=base_path,
+        metadata=metadata,
+        available=get_available_entries(metadata.manifest, rank),
+        base_paths=[encode_base_ref(base_path, own_path)],
+    )
+    # Flatten the base's own reference roots into our namespace. The
+    # list is a pure function of (base metadata, the two paths), so
+    # every rank derives the identical namespace with no collective.
+    for k, ref in enumerate(metadata.base_paths):
+        resolved = resolve_base_ref(ref, base_path)
+        ours = encode_base_ref(resolved, own_path)
+        if ours in ctx.base_paths:
+            ctx.idx_map[k] = ctx.base_paths.index(ours)
+        else:
+            ctx.idx_map[k] = len(ctx.base_paths)
+            ctx.base_paths.append(ours)
+    return ctx
+
+
+def _is_jax_array(obj: Any) -> bool:
+    import jax
+
+    return isinstance(obj, jax.Array)
+
+
+def _compute_fingerprints(
+    write_reqs: List[WriteReq], stats: IncrementalStats
+) -> Dict[int, str]:
+    """Fingerprint every array write request's payload, device-side for
+    device-resident data. Returns {id(entry): fingerprint}.
+
+    Device computations are dispatched for ALL leaves first (jax's async
+    dispatch pipelines them on device), then resolved — the blocking
+    per-leaf cost is one 16-byte device→host fetch, not a serialized
+    compute+fetch per leaf.
+    """
+    from .fingerprint import (
+        fingerprint_device_async,
+        fingerprint_host,
+        format_fingerprint,
+    )
+    from .io_preparer import ArrayBufferStager
+
+    pending: List[Tuple[ArrayEntry, Any]] = []
+    fingerprints: Dict[int, str] = {}
+    failed_dtypes: set = set()
+
+    def _note_failure(dtype: Any, e: Exception) -> None:
+        # Fingerprint failures DEGRADE (full write, no dedup) — the
+        # safety model forbids them from aborting a checkpoint take.
+        key = str(dtype)
+        if key not in failed_dtypes:
+            failed_dtypes.add(key)
+            logger.warning(
+                f"content fingerprint unavailable for dtype {key} "
+                f"({e!r}); affected leaves are written in full"
+            )
+
+    for wr in write_reqs:
+        stager = wr.buffer_stager
+        if not isinstance(stager, ArrayBufferStager):
+            continue
+        entry = stager._entry
+        data = stager._data
+        if entry is None or data is None or not isinstance(entry, ArrayEntry):
+            continue
+        if _is_jax_array(data):
+            try:
+                pending.append(
+                    (
+                        entry,
+                        fingerprint_device_async(data, stager._chunk_slices),
+                    )
+                )
+            except Exception as e:
+                _note_failure(data.dtype, e)
+        else:
+            try:
+                host = np.asarray(data)
+                if stager._chunk_slices is not None:
+                    host = host[stager._chunk_slices]
+                fingerprints[id(entry)] = fingerprint_host(
+                    np.ascontiguousarray(host)
+                )
+                stats.fingerprinted += 1
+            except Exception as e:
+                _note_failure(getattr(data, "dtype", type(data)), e)
+    for entry, result in pending:
+        try:
+            fingerprints[id(entry)] = format_fingerprint(np.asarray(result))
+            stats.fingerprinted += 1
+        except Exception as e:
+            _note_failure(entry.dtype, e)
+    return fingerprints
+
+
+def _entry_nbytes(entry: ArrayEntry) -> int:
+    from .serialization import array_nbytes
+
+    try:
+        return array_nbytes(entry.dtype, entry.shape)
+    except Exception:
+        return 0
+
+
+def _rewrite_to_ref(
+    entry: ArrayEntry,
+    base_entry: ArrayEntry,
+    ctx: _BaseContext,
+    fingerprint: Optional[str],
+    used_idxs: set,
+) -> None:
+    """Point ``entry`` at the base snapshot's stored object."""
+    if base_entry.base is not None:
+        # The base itself borrowed this object from an older snapshot:
+        # reference the ORIGINAL directly (chains never deepen).
+        our_idx = ctx.idx_map[base_entry.base]
+    else:
+        our_idx = 0
+    # The base metadata may come from a handle whose cache was DECORATED
+    # for restore ("@base<k>/<loc>"); the bare location is canonical.
+    location = base_entry.location
+    parsed = parse_ref_location(location)
+    if parsed is not None:
+        location = parsed[1]
+    entry.location = location
+    entry.base = our_idx
+    entry.serializer = base_entry.serializer
+    entry.checksum = base_entry.checksum
+    entry.compression = base_entry.compression
+    entry.fingerprint = fingerprint
+    used_idxs.add(our_idx)
+
+
+def _dense_match(
+    entry: ArrayEntry, base_entry: Entry, fp: Optional[str]
+) -> bool:
+    return (
+        fp is not None
+        and isinstance(base_entry, ArrayEntry)
+        and base_entry.fingerprint == fp
+        and base_entry.checksum is not None
+        and base_entry.dtype == entry.dtype
+        and list(base_entry.shape) == list(entry.shape)
+        and base_entry.prng_impl == entry.prng_impl
+    )
+
+
+def apply_incremental(
+    manifest: Manifest,
+    write_reqs: List[WriteReq],
+    *,
+    rank: int,
+    own_path: str,
+    base_path: Optional[str],
+    record_fingerprints: bool,
+    base_metadata: Optional[SnapshotMetadata] = None,
+) -> Tuple[List[str], IncrementalStats]:
+    """Fingerprint array payloads and (when ``base_path`` is given)
+    dedup unchanged ones against the base snapshot.
+
+    Mutates ``manifest`` entries in place (entries are shared with the
+    stagers' back-patch references) and drops deduplicated requests from
+    ``write_reqs``. Returns the ``base_paths`` list for this take's
+    metadata (empty when no base) and the dedup stats. Runs BEFORE
+    staging/cloning, so a dedup hit skips the device→host transfer, the
+    storage write, and (async takes) the device clone. No collectives —
+    per-rank divergence in hit counts is fine; the reference namespace
+    itself is rank-deterministic.
+    """
+    stats = IncrementalStats()
+    if base_path is None and not record_fingerprints:
+        return [], stats
+
+    fingerprints = _compute_fingerprints(write_reqs, stats)
+    if record_fingerprints:
+        # Record fingerprints on the entries (the manifest aliases
+        # them). With fingerprint=False + base, they are computed only
+        # to COMPARE — the user opted out of growing the manifest /
+        # making this snapshot a future base.
+        for wr in write_reqs:
+            entry = getattr(wr.buffer_stager, "_entry", None)
+            if isinstance(entry, ArrayEntry) and id(entry) in fingerprints:
+                entry.fingerprint = fingerprints[id(entry)]
+
+    if base_path is None:
+        stats.written = len(write_reqs)
+        return [], stats
+
+    ctx = load_base_context(
+        base_path, own_path, rank, metadata=base_metadata
+    )
+    dropped: set = set()
+    used_idxs: set = set()
+
+    for logical_path, entry in manifest.items():
+        base_entry = ctx.available.get(logical_path)
+        if base_entry is None:
+            continue
+        if isinstance(entry, ArrayEntry):
+            fp = fingerprints.get(id(entry))
+            if id(entry) in dropped or not _dense_match(entry, base_entry, fp):
+                continue
+            _rewrite_to_ref(
+                entry,
+                base_entry,
+                ctx,
+                fp if record_fingerprints else None,
+                used_idxs,
+            )
+            dropped.add(id(entry))
+            stats.dedup_hits += 1
+            stats.dedup_bytes += _entry_nbytes(entry)
+        elif isinstance(entry, ShardedArrayEntry) and isinstance(
+            base_entry, ShardedArrayEntry
+        ):
+            if (
+                entry.dtype != base_entry.dtype
+                or list(entry.shape) != list(base_entry.shape)
+                or entry.prng_impl != base_entry.prng_impl
+            ):
+                continue
+            by_region = {
+                (tuple(s.offsets), tuple(s.sizes)): s.array
+                for s in base_entry.shards
+            }
+            for shard in entry.shards:
+                chunk = shard.array
+                fp = fingerprints.get(id(chunk))
+                if fp is None or id(chunk) in dropped:
+                    continue
+                candidate = by_region.get(
+                    (tuple(shard.offsets), tuple(shard.sizes))
+                )
+                if (
+                    candidate is None
+                    or candidate.fingerprint != fp
+                    or candidate.checksum is None
+                    or candidate.dtype != chunk.dtype
+                ):
+                    continue
+                _rewrite_to_ref(
+                    chunk,
+                    candidate,
+                    ctx,
+                    fp if record_fingerprints else None,
+                    used_idxs,
+                )
+                dropped.add(id(chunk))
+                stats.dedup_hits += 1
+                stats.dedup_bytes += _entry_nbytes(chunk)
+
+    if dropped:
+        write_reqs[:] = [
+            wr
+            for wr in write_reqs
+            if id(getattr(wr.buffer_stager, "_entry", None)) not in dropped
+        ]
+        _write_back_link(ctx, own_path, rank, used_idxs)
+    stats.written = len(write_reqs)
+    if stats.dedup_hits:
+        logger.info(
+            f"incremental take: rank {rank} deduplicated {stats.dedup_hits} "
+            f"object(s) (~{stats.dedup_bytes / (1 << 20):.1f} MiB) against "
+            f"{base_path}"
+        )
+    return ctx.base_paths, stats
+
+
+def _write_back_link(
+    ctx: _BaseContext, own_path: str, rank: int, used_idxs: set
+) -> None:
+    """Durably mark each referenced base snapshot BEFORE this take can
+    commit. The marker records the referencing snapshot (relative when a
+    sibling, mirroring metadata base_paths), so ``delete`` on the base
+    can discover live referencers; a marker whose referencing snapshot
+    never committed (crashed take) is stale and swept by delete.
+
+    The marker name is a DETERMINISTIC function of the referencing
+    snapshot, so the write is idempotent: N ranks over M takes leave one
+    marker per (base, referencing snapshot) pair — concurrent PUTs carry
+    identical bytes — instead of N×M accumulating objects that every
+    future ``delete`` on a long-lived base would have to read."""
+    import hashlib
+
+    for idx in sorted(used_idxs):
+        root = resolve_base_ref(ctx.base_paths[idx], own_path)
+        storage = url_to_storage_plugin(root)
+        try:
+            child_ref = encode_base_ref(own_path, root)
+            name = hashlib.sha1(child_ref.encode()).hexdigest()[:16]
+            marker = IOReq(path=f"{REFS_PREFIX}inc_{name}")
+            marker.buf.write(json.dumps({"path": child_ref}).encode())
+            asyncio.run(storage.write(marker))
+        finally:
+            storage.close()
+
+
+async def referencing_snapshots(
+    storage: StoragePlugin, own_path: str
+) -> List[Tuple[str, str]]:
+    """Back-link markers in THIS snapshot's prefix: [(marker_path,
+    resolved_referencing_snapshot_url)]. Malformed markers resolve to
+    an empty URL (caller treats as stale)."""
+    paths = await storage.list_prefix(REFS_PREFIX)
+    if paths is None:
+        return []
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        try:
+            io_req = IOReq(path=p)
+            await storage.read(io_req)
+            doc = json.loads(bytes(io_payload(io_req)).decode())
+            out.append((p, resolve_base_ref(doc["path"], own_path)))
+        except Exception as e:
+            logger.warning(f"unreadable back-link marker {p}: {e!r}")
+            out.append((p, ""))
+    return out

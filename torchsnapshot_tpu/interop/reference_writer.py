@@ -78,11 +78,16 @@ def convert_back(native_path: str, dest_path: str) -> None:
     reference-torchsnapshot-readable snapshot at ``dest_path``."""
     from ..snapshot import Snapshot
 
-    storage_in = url_to_storage_plugin(native_path)
+    # _open_storage routes incremental references ("@base<N>/…"
+    # locations an incremental snapshot's decorated manifest carries) to
+    # their base roots; the export below then materializes those
+    # payloads, so the reference-format copy is always self-contained.
+    src = Snapshot(native_path)
+    storage_in = src._open_storage()
     storage_out = url_to_storage_plugin(dest_path)
     budget = get_local_memory_budget_bytes()
     try:
-        metadata = Snapshot(native_path)._read_snapshot_metadata(storage_in)
+        metadata = src._read_snapshot_metadata(storage_in)
         world_size = metadata.world_size
 
         ref_manifest: Dict[str, Dict[str, Any]] = {}

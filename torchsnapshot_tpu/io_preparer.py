@@ -196,12 +196,7 @@ class ArrayBufferStager(BufferStager):
         if nbytes is None:
             nbytes = int(np.dtype(data.dtype).itemsize * np.prod(data.shape))
         self._nbytes = nbytes
-        if (
-            eager_host_copy
-            and _is_jax_array(data)
-            and chunk_slices is None
-            and not _should_chunk_transfer(data)
-        ):
+        if eager_host_copy:
             # Small arrays: start the whole-array async copy now so the
             # transfer overlaps with scheduling. Large arrays skip this —
             # they stage via parallel chunked transfers instead, and a
@@ -209,6 +204,21 @@ class ArrayBufferStager(BufferStager):
             # slow single stream. Async takes pass eager_host_copy=False:
             # a device-staged cut rebinds stagers to on-device clones, and
             # a transfer started on the original would never be consumed.
+            # Incremental takes also pass False — a dedup hit must skip
+            # the transfer entirely; apply_incremental kicks off copies
+            # for the SURVIVING requests afterwards.
+            self.kickoff_host_copy()
+
+    def kickoff_host_copy(self) -> None:
+        """Dispatch the async device→host copy for a small whole-array
+        payload (no-op for chunked/sliced/host data or once staged)."""
+        data = self._data
+        if (
+            data is not None
+            and _is_jax_array(data)
+            and self._chunk_slices is None
+            and not _should_chunk_transfer(data)
+        ):
             try:
                 data.copy_to_host_async()
             except Exception:  # pragma: no cover - platform-dependent

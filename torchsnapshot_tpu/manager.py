@@ -335,6 +335,24 @@ class CheckpointManager:
                 logger.warning(f"Ignoring malformed prune tombstone: {t}")
         for step in sorted(set(doomed)):
             try:
+                # A step that live incremental snapshots still reference
+                # holds THEIR data: defer BEFORE tombstoning, so the
+                # step keeps its marker (stays resolvable/restorable)
+                # and max_to_keep is visibly, not silently, exceeded.
+                # Deferred steps re-enter `doomed` on later prunes and
+                # fall out once their referencers are pruned.
+                try:
+                    referenced = Snapshot(
+                        _step_dir(self.base_path, step)
+                    ).is_referenced()
+                except Exception:
+                    referenced = False  # delete() itself re-checks
+                if referenced:
+                    logger.info(
+                        f"Prune of step {step} deferred: still "
+                        f"referenced by incremental snapshot(s)."
+                    )
+                    continue
                 tomb = IOReq(path=f"{_PRUNING_PREFIX}{step}")
                 tomb.buf.write(b"1")
                 asyncio.run(storage.write(tomb))

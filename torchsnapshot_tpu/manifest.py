@@ -69,6 +69,15 @@ class ArrayEntry(Entry):
     # Lossless compression of the stored payload ("zlib" or None). The
     # checksum covers the stored (compressed) bytes.
     compression: Optional[str] = None
+    # Content fingerprint ("xs128:<32 hex>") of the UNCOMPRESSED logical
+    # payload — the dedup key for incremental snapshots (see
+    # fingerprint.py). Recorded when fingerprinting is enabled on take.
+    fingerprint: Optional[str] = None
+    # Incremental-snapshot reference: when set, `location` lives under
+    # the snapshot root named by `SnapshotMetadata.base_paths[base]`
+    # instead of this snapshot's own root (the payload was unchanged
+    # since that base take and was never rewritten). None = own root.
+    base: Optional[int] = None
 
     def __init__(
         self,
@@ -80,6 +89,8 @@ class ArrayEntry(Entry):
         prng_impl: Optional[str] = None,
         checksum: Optional[str] = None,
         compression: Optional[str] = None,
+        fingerprint: Optional[str] = None,
+        base: Optional[int] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -90,6 +101,8 @@ class ArrayEntry(Entry):
         self.prng_impl = prng_impl
         self.checksum = checksum
         self.compression = compression
+        self.fingerprint = fingerprint
+        self.base = base
 
 
 @dataclass
@@ -232,6 +245,19 @@ Manifest = Dict[str, Entry]
 _SCHEMA_VERSION = "0.1.0"
 
 
+def _array_entry_dict(e: "ArrayEntry") -> Dict[str, Any]:
+    # The incremental-snapshot fields are None on the vast majority of
+    # entries; omitting them keeps a 100k-entry FSDP manifest from
+    # growing by megabytes of `null`s (from_yaml uses .get, so omission
+    # and null are equivalent).
+    d = dict(e.__dict__)
+    if d.get("fingerprint") is None:
+        d.pop("fingerprint", None)
+    if d.get("base") is None:
+        d.pop("base", None)
+    return d
+
+
 def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
     if isinstance(entry, ShardedArrayEntry):
         # Lists are aliased, not copied: json.dumps only reads them, and
@@ -247,12 +273,15 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
                 {
                     "offsets": s.offsets,
                     "sizes": s.sizes,
-                    "array": dict(s.array.__dict__),
+                    "array": _array_entry_dict(s.array),
                 }
                 for s in entry.shards
             ],
         }
-    d = dict(entry.__dict__)
+    if isinstance(entry, ArrayEntry):
+        d = _array_entry_dict(entry)
+    else:
+        d = dict(entry.__dict__)
     d["type"] = entry.type
     return d
 
@@ -276,6 +305,8 @@ def _array_entry_from_dict(d: Dict[str, Any]) -> "ArrayEntry":
         "prng_impl": get("prng_impl"),
         "checksum": get("checksum"),
         "compression": get("compression"),
+        "fingerprint": get("fingerprint"),
+        "base": get("base"),
     }
     return e
 
@@ -393,6 +424,12 @@ class SnapshotMetadata:
     # successive takes to the same path whose manifests are byte-identical
     # (manifests record structure, not values).
     take_id: Optional[str] = None
+    # Incremental-snapshot base roots referenced by entries' `base`
+    # indices. Each item is "rel:<sibling-name>" (a snapshot in the same
+    # parent directory — survives moving the whole family) or
+    # "abs:<url>" (an arbitrary root). Empty for self-contained
+    # snapshots (omitted from the serialized document).
+    base_paths: List[str] = field(default_factory=list)
 
     def to_yaml(self) -> str:
         doc = {
@@ -403,6 +440,8 @@ class SnapshotMetadata:
                 path: _entry_to_dict(entry) for path, entry in self.manifest.items()
             },
         }
+        if self.base_paths:
+            doc["base_paths"] = self.base_paths
         # Emit the JSON subset of YAML. Every JSON document is a valid
         # YAML document, so anything that speaks YAML still reads the
         # metadata — but serialization goes through the C json codec,
@@ -430,6 +469,7 @@ class SnapshotMetadata:
             world_size=doc["world_size"],
             manifest=manifest,
             take_id=doc.get("take_id"),
+            base_paths=list(doc.get("base_paths") or []),
         )
 
 

@@ -52,6 +52,7 @@ from . import tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .flatten import flatten, inflate
 from .io_preparer import (
+    ArrayBufferStager,
     device_clone_write_reqs,
     get_device_restore_budget_bytes,
     prepare_read,
@@ -85,7 +86,14 @@ from .scheduler import (
     get_process_memory_budget_bytes,
 )
 from .stateful import AppState, Stateful
-from .storage_plugin import url_to_storage_plugin
+from .storage_plugin import (
+    RefRouterPlugin,
+    is_ref_location,
+    make_ref_location,
+    parse_ref_location,
+    resolve_base_ref,
+    url_to_storage_plugin,
+)
 from .utils.env import env_int
 from .version import __version__
 
@@ -121,6 +129,8 @@ class Snapshot:
         coord: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         compression: Optional[str] = None,
+        base: Optional[Any] = None,
+        fingerprint: Optional[bool] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` to ``path``; returns a handle.
 
@@ -128,10 +138,25 @@ class Snapshot:
         None) losslessly compresses stored payloads (beyond parity); the
         restore side is driven entirely by the manifest, so no flag is
         needed on restore.
+
+        ``base`` (a committed :class:`Snapshot` or its path — beyond
+        parity, see incremental.py) makes this an INCREMENTAL take:
+        arrays whose device-computed content fingerprint matches what
+        ``base`` recorded skip the device→host transfer and the storage
+        write; their manifest entries reference the base's objects.
+        ``fingerprint`` controls whether content fingerprints are
+        recorded on this take's entries (the prerequisite for a future
+        take to use THIS snapshot as a base); default: on when ``base``
+        is given or ``TPUSNAPSHOT_FINGERPRINT=1``. Like ``path``, both
+        must be uniform across ranks.
         """
         check_compression(compression)
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
+        base_path, fingerprint = _collate_incremental_args(
+            coordinator, _resolve_base_arg(base, path), fingerprint
+        )
+        _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
         try:
             with tracing.span("Snapshot.take", path=path):
@@ -143,6 +168,9 @@ class Snapshot:
                     replicated=replicated or [],
                     background=None,
                     compression=compression,
+                    base_path=base_path,
+                    fingerprint=fingerprint,
+                    base_metadata=_reusable_base_metadata(base, base_path),
                 )
         finally:
             storage.close()
@@ -157,6 +185,8 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         compression: Optional[str] = None,
         stage: str = "auto",
+        base: Optional[Any] = None,
+        fingerprint: Optional[bool] = None,
     ) -> "PendingSnapshot":
         """Take a snapshot with storage writes overlapped with training.
 
@@ -184,6 +214,10 @@ class Snapshot:
             )
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
+        base_path, fingerprint = _collate_incremental_args(
+            coordinator, _resolve_base_arg(base, path), fingerprint
+        )
+        _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
         background = _BackgroundTake()
         try:
@@ -196,6 +230,9 @@ class Snapshot:
                 background=background,
                 compression=compression,
                 stage=stage,
+                base_path=base_path,
+                fingerprint=fingerprint,
+                base_metadata=_reusable_base_metadata(base, base_path),
             )
         except BaseException:
             storage.close()
@@ -215,6 +252,9 @@ class Snapshot:
         background: Optional["_BackgroundTake"],
         compression: Optional[str] = None,
         stage: str = "auto",
+        base_path: Optional[str] = None,
+        fingerprint: Optional[bool] = None,
+        base_metadata: Optional[SnapshotMetadata] = None,
     ) -> None:
         app_state = dict(app_state)
         rank = coordinator.get_rank()
@@ -245,7 +285,7 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
-                eager_host_copy=background is None,
+                eager_host_copy=background is None and base_path is None,
             )
 
         global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
@@ -260,9 +300,43 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
-                eager_host_copy=background is None,
+                eager_host_copy=background is None and base_path is None,
             )
             coordinator.barrier()
+
+        # Incremental/fingerprint pass (beyond parity — see incremental.py).
+        # Runs BEFORE staging/cloning so a dedup hit skips the device→host
+        # transfer (and, async, the device clone), not just the storage
+        # write. No collectives inside; the base_paths namespace is
+        # rank-deterministic, so the merged metadata is consistent even
+        # when hit counts differ across ranks.
+        fingerprint_enabled = (
+            fingerprint
+            if fingerprint is not None
+            else (base_path is not None or env_int("TPUSNAPSHOT_FINGERPRINT", 0) != 0)
+        )
+        base_paths_meta: List[str] = []
+        if base_path is not None or fingerprint_enabled:
+            from .incremental import apply_incremental
+
+            with tracing.span("Snapshot.incremental", path=path):
+                base_paths_meta, _ = apply_incremental(
+                    manifest,
+                    pending_write_reqs,
+                    rank=rank,
+                    own_path=path,
+                    base_path=base_path,
+                    record_fingerprints=fingerprint_enabled,
+                    base_metadata=base_metadata,
+                )
+            if background is None and base_path is not None:
+                # Sync takes suppressed prepare-time eager D2H copies so
+                # dedup hits never pay the transfer; start them now for
+                # the payloads that WILL be written.
+                for wr in pending_write_reqs:
+                    stager = wr.buffer_stager
+                    if isinstance(stager, ArrayBufferStager):
+                        stager.kickoff_host_copy()
 
         budget = get_process_memory_budget_bytes(coordinator)
 
@@ -310,6 +384,7 @@ class Snapshot:
                         coordinator.get_world_size(),
                         manifest,
                         take_id,
+                        base_paths=base_paths_meta,
                     )
                 )
             else:
@@ -318,7 +393,10 @@ class Snapshot:
                 # every rank finished its writes, so metadata-last
                 # ordering is guaranteed.
                 metadata = _gather_manifest(
-                    coordinator, manifest, take_id=take_id
+                    coordinator,
+                    manifest,
+                    take_id=take_id,
+                    base_paths=base_paths_meta,
                 )
                 if rank == 0:
                     _write_snapshot_metadata(storage, metadata)
@@ -369,7 +447,12 @@ class Snapshot:
                     # into the entries, and under a device-staged cut
                     # staging itself runs in this background drain.
                     await _acommit_via_storage(
-                        storage, rank, world_size, manifest, nonce
+                        storage,
+                        rank,
+                        world_size,
+                        manifest,
+                        nonce,
+                        base_paths=base_paths_meta,
                     )
 
                 asyncio.run(_run())
@@ -401,7 +484,7 @@ class Snapshot:
         """
         coordinator = get_coordinator(coord if coord is not None else self._coord)
         rank = coordinator.get_rank()
-        storage = url_to_storage_plugin(self.path)
+        storage = self._open_storage()
         try:
             with tracing.span("Snapshot.restore", path=self.path):
                 return self._restore_impl(
@@ -463,7 +546,7 @@ class Snapshot:
                 f'"model/params/w"; see get_manifest().'
             )
 
-    def delete(self, sweep: bool = False) -> None:
+    def delete(self, sweep: bool = False, force: bool = False) -> None:
         """Delete this snapshot from storage (beyond reference parity —
         the reference leaves snapshot GC entirely to the user).
 
@@ -474,6 +557,16 @@ class Snapshot:
         async-commit markers are removed. Not-found objects are skipped
         (delete is idempotent). Single-process operation — run it from
         one rank or an offline tool.
+
+        Incremental-snapshot safety: objects borrowed FROM a base
+        snapshot are never deleted (they are the base's to delete), and
+        if a LIVE incremental snapshot still references this one (its
+        back-link marker resolves to committed metadata whose base_paths
+        name this snapshot), delete refuses with ``RuntimeError`` —
+        deleting the base would silently corrupt every snapshot built on
+        it. ``force=True`` overrides (e.g. after ``copy_to``-
+        materializing the children). Stale markers (crashed or deleted
+        referencers) are swept, not honored.
 
         ``sweep=True`` additionally enumerates the snapshot prefix and
         removes objects the manifest does NOT reference — orphans from
@@ -504,7 +597,7 @@ class Snapshot:
                 f"{os.environ['TPUSNAPSHOT_SWEEP_MIN_AGE_S']!r}: expected "
                 f"seconds as a number"
             ) from e
-        storage = url_to_storage_plugin(self.path)
+        storage = self._open_storage()
         try:
             try:
                 metadata = self._read_snapshot_metadata(storage)
@@ -517,17 +610,49 @@ class Snapshot:
                         f"({e!r}); proceeding with sweep-only delete."
                     )
                 metadata = None  # uncommitted/corrupt take: sweep-only
+            # The in-flight-take marker guard has its OWN age knob: tests
+            # and ops runbooks set TPUSNAPSHOT_SWEEP_MIN_AGE_S=0 to force
+            # unconditional sweeps, and that must not silently disable
+            # the protection against deleting a base mid-child-take.
+            try:
+                refs_min_age_s = float(
+                    os.environ.get("TPUSNAPSHOT_REFS_MIN_AGE_S", 3600)
+                )
+            except ValueError as e:
+                raise ValueError(
+                    f"Malformed TPUSNAPSHOT_REFS_MIN_AGE_S="
+                    f"{os.environ['TPUSNAPSHOT_REFS_MIN_AGE_S']!r}: "
+                    f"expected seconds as a number"
+                ) from e
+            refs = asyncio.run(
+                _live_referencers(storage, self.path, refs_min_age_s)
+            )
+            if refs and not force:
+                raise RuntimeError(
+                    f"Snapshot {self.path} is still referenced by "
+                    f"incremental snapshot(s) {sorted(refs)}; deleting it "
+                    f"would corrupt them. Delete (or copy_to-materialize) "
+                    f"those first, or pass force=True."
+                )
             locations: Set[str] = set()
             markers: List[str] = []
             if metadata is not None:
+                # Locations decorated "@base<N>/…" are borrowed from a
+                # base snapshot — not ours to delete.
                 locations = {
-                    e.location for e in _iter_payload_entries(metadata.manifest)
+                    e.location
+                    for e in _iter_payload_entries(metadata.manifest)
+                    if not is_ref_location(e.location)
                 }
                 markers = [
                     f".completed/{metadata.take_id}/{r}"
                     for r in range(metadata.world_size)
                     if metadata.take_id
                 ]
+            # Our own back-link markers (refs/ in OUR prefix) go with us.
+            own_markers = asyncio.run(storage.list_prefix("refs/"))
+            if own_markers:
+                markers = markers + list(own_markers)
 
             async def _delete_all() -> None:
                 # Uncommit first; then payload deletes are order-
@@ -592,6 +717,37 @@ class Snapshot:
                     )
 
             asyncio.run(_delete_all())
+            # This snapshot referenced base snapshots: clear OUR
+            # back-link markers from their roots so they become
+            # deletable once their last referencer is gone.
+            # Best-effort — a stale marker is detected (and swept) by
+            # the base's own delete anyway.
+            if metadata is not None and metadata.base_paths:
+                try:
+                    asyncio.run(_gc_backlinks_in_bases(metadata, self.path))
+                except Exception as e:
+                    logger.warning(f"back-link marker GC failed: {e!r}")
+        finally:
+            storage.close()
+
+    def is_referenced(self) -> bool:
+        """Whether a live incremental snapshot still references this
+        snapshot's objects (see ``delete``'s incremental-safety notes).
+        Retention policies should treat a referenced snapshot as
+        holding live data: defer its deletion rather than force it."""
+        try:
+            refs_min_age_s = float(
+                os.environ.get("TPUSNAPSHOT_REFS_MIN_AGE_S", 3600)
+            )
+        except ValueError:
+            refs_min_age_s = 3600.0
+        storage = self._open_storage()
+        try:
+            return bool(
+                asyncio.run(
+                    _live_referencers(storage, self.path, refs_min_age_s)
+                )
+            )
         finally:
             storage.close()
 
@@ -619,7 +775,7 @@ class Snapshot:
 
         from .serialization import array_nbytes
 
-        src = url_to_storage_plugin(self.path)
+        src = self._open_storage()
         dst = url_to_storage_plugin(dest_path)
         try:
             metadata = self._read_snapshot_metadata(src)
@@ -696,7 +852,13 @@ class Snapshot:
                                     payload,
                                     getattr(entry, "checksum", None),
                                 )
-                            out = IOReq(path=loc, data=payload)
+                            # Payloads borrowed from a base snapshot
+                            # MATERIALIZE: they land at their bare
+                            # location under the destination's own
+                            # root (the copy is self-contained).
+                            parsed = parse_ref_location(loc)
+                            out_path = loc if parsed is None else parsed[1]
+                            out = IOReq(path=out_path, data=payload)
                             await dst.write(out)
                     finally:
                         async with gate:
@@ -708,7 +870,21 @@ class Snapshot:
                 )
 
             asyncio.run(_copy_all())
-            _write_snapshot_metadata(dst, metadata)
+            # The destination is SELF-CONTAINED: borrowed payloads were
+            # materialized above, so its metadata must not carry base
+            # references. Rewrite a round-tripped copy (never mutate the
+            # cached metadata this handle keeps using).
+            dest_metadata = metadata
+            if metadata.base_paths:
+                dest_metadata = SnapshotMetadata.from_yaml(metadata.to_yaml())
+                dest_metadata.base_paths = []
+                for e in _iter_payload_entries(dest_metadata.manifest):
+                    parsed = parse_ref_location(e.location)
+                    if parsed is not None:
+                        e.location = parsed[1]
+                    if getattr(e, "base", None) is not None:
+                        e.base = None
+            _write_snapshot_metadata(dst, dest_metadata)
         finally:
             src.close()
             dst.close()
@@ -718,7 +894,7 @@ class Snapshot:
 
     def get_manifest(self) -> Manifest:
         """The merged manifest of all ranks (inspection API)."""
-        storage = url_to_storage_plugin(self.path)
+        storage = self._open_storage()
         try:
             return dict(self._read_snapshot_metadata(storage).manifest)
         finally:
@@ -737,7 +913,7 @@ class Snapshot:
         """
         from .serialization import StreamingCrc32, array_nbytes, verify_checksum
 
-        storage = url_to_storage_plugin(self.path)
+        storage = self._open_storage()
         problems: Dict[str, str] = {}
         try:
             metadata = self._read_snapshot_metadata(storage)
@@ -945,7 +1121,7 @@ class Snapshot:
         """
         coordinator = get_coordinator(self._coord)
         rank = coordinator.get_rank() if rank is None else rank
-        storage = url_to_storage_plugin(self.path)
+        storage = self._open_storage()
         try:
             metadata = self._read_snapshot_metadata(storage)
             available = get_available_entries(metadata.manifest, rank)
@@ -1039,14 +1215,40 @@ class Snapshot:
         finally:
             storage.close()
 
+    def _open_storage(self) -> StoragePlugin:
+        """The snapshot's storage root, wrapped so incremental-snapshot
+        references (``@base<N>/…`` locations) route to their base roots.
+        Ordinary paths pass through untouched, so callers that never see
+        a ref pay nothing."""
+        return RefRouterPlugin(url_to_storage_plugin(self.path))
+
     def _read_snapshot_metadata(self, storage: StoragePlugin) -> SnapshotMetadata:
         if self._metadata_cache is None:
             io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
             asyncio.run(storage.read(io_req))
-            self._metadata_cache = SnapshotMetadata.from_yaml(
+            metadata = SnapshotMetadata.from_yaml(
                 _decode_metadata_doc(bytes(io_payload(io_req)))
             )
-        return self._metadata_cache
+            # Decorate incremental references ONCE (cache-guarded):
+            # entries whose payload lives in a base snapshot get routed
+            # locations, so every downstream path — restore, verify,
+            # copy_to, read_object — resolves them through the router
+            # with no further special-casing.
+            if metadata.base_paths:
+                for e in _iter_payload_entries(metadata.manifest):
+                    base_idx = getattr(e, "base", None)
+                    if base_idx is not None and not is_ref_location(e.location):
+                        e.location = make_ref_location(base_idx, e.location)
+            self._metadata_cache = metadata
+        metadata = self._metadata_cache
+        if metadata.base_paths and isinstance(storage, RefRouterPlugin):
+            # Attach per-storage-instance (the cache outlives any one
+            # plugin): resolve rel: references against the CURRENT path,
+            # so a moved/renamed snapshot family keeps working.
+            storage.attach_bases(
+                [resolve_base_ref(r, self.path) for r in metadata.base_paths]
+            )
+        return metadata
 
     @staticmethod
     def _collate_path(coordinator: Coordinator, path: str) -> str:
@@ -1153,6 +1355,65 @@ class PendingSnapshot:
 
 
 # ------------------------------------------------------------------ helpers
+
+
+def _resolve_base_arg(base: Optional[Any], path: str) -> Optional[str]:
+    """Normalize take's ``base`` argument (a Snapshot or a path string).
+    Never raises: validation happens AFTER the collation collective, so
+    every rank raises (or proceeds) uniformly — a pre-collective raise
+    on one rank would strand its peers in the broadcast."""
+    if base is None:
+        return None
+    return base.path if isinstance(base, Snapshot) else str(base)
+
+
+def _reusable_base_metadata(
+    base: Optional[Any], collated_base_path: Optional[str]
+) -> Optional[SnapshotMetadata]:
+    """A Snapshot handle's cached metadata, reusable for the incremental
+    pass iff the handle is the collectively-agreed base — skips one
+    metadata GET + parse per take (multi-MB at FSDP scale). The dedup
+    logic tolerates the cache's decorated ("@base…") locations."""
+    if (
+        isinstance(base, Snapshot)
+        and collated_base_path is not None
+        and base.path == collated_base_path
+    ):
+        return base._metadata_cache  # may be None: caller reads storage
+    return None
+
+
+def _collate_incremental_args(
+    coordinator: Coordinator,
+    base_path: Optional[str],
+    fingerprint: Optional[bool],
+) -> Tuple[Optional[str], Optional[bool]]:
+    """Make ``base``/``fingerprint`` collective like ``path``: rank 0's
+    values are authoritative. Divergence is a real hazard, not a
+    nicety — entry ``base`` indices resolve against the MERGED
+    metadata's base_paths (rank 0's namespace), so a rank deduping
+    against a different base would commit references that resolve to
+    the wrong snapshot's bytes."""
+    collated = coordinator.broadcast_object((base_path, fingerprint), src=0)
+    if collated != (base_path, fingerprint):
+        logger.warning(
+            f"Rank {coordinator.get_rank()} passed "
+            f"(base={base_path!r}, fingerprint={fingerprint!r}) but rank 0 "
+            f"passed (base={collated[0]!r}, fingerprint={collated[1]!r}). "
+            f"Using rank 0's."
+        )
+    return collated
+
+
+def _validate_base_path(base_path: Optional[str], path: str) -> None:
+    """Reject self-reference (post-collation, so uniformly across
+    ranks) — a snapshot taking itself as base would reference objects
+    the take is about to overwrite."""
+    if base_path is not None and base_path.rstrip("/") == path.rstrip("/"):
+        raise ValueError(
+            f"base snapshot path equals the take path ({path!r}); an "
+            f"incremental take must write to a NEW path"
+        )
 
 
 def _pop_rng_state(app_state: Dict[str, Stateful]) -> Tuple[str, Optional[RNGState]]:
@@ -1344,6 +1605,90 @@ async def _delete_ignore_missing(storage: StoragePlugin, path: str) -> None:
             raise
 
 
+async def _aread_metadata_at(url: str) -> SnapshotMetadata:
+    storage = url_to_storage_plugin(url)
+    try:
+        io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
+        await storage.read(io_req)
+        return SnapshotMetadata.from_yaml(
+            _decode_metadata_doc(bytes(io_payload(io_req)))
+        )
+    finally:
+        storage.close()
+
+
+async def _live_referencers(
+    storage: StoragePlugin, own_path: str, min_age_s: float
+) -> Set[str]:
+    """Incremental snapshots that still depend on ``own_path``'s objects.
+
+    A back-link marker (written by apply_incremental before the
+    referencing take could commit) is LIVE if the snapshot it names has
+    committed metadata whose entries actually reference this root — OR
+    if the marker is younger than ``min_age_s`` with no committed
+    metadata yet: that is exactly what an IN-FLIGHT incremental take
+    looks like (marker lands before any payload write), and deleting the
+    base mid-take would let the child commit references to objects that
+    no longer exist. Unknown marker age fails closed too. Only a marker
+    that is demonstrably old with no committed referencing metadata (a
+    crashed take, a deleted child) is stale and ignored."""
+    from .incremental import referencing_snapshots
+
+    live: Set[str] = set()
+    own = own_path.rstrip("/")
+    for marker_path, ref_url in await referencing_snapshots(storage, own_path):
+        if not ref_url or ref_url.rstrip("/") in live:
+            continue
+        try:
+            md = await _aread_metadata_at(ref_url)
+        except Exception:
+            # No committed metadata: in-flight take or stale leftover —
+            # distinguish by marker age, failing closed when unknown.
+            if min_age_s > 0:
+                try:
+                    age = await storage.object_age_s(marker_path)
+                except Exception:
+                    age = None
+                if age is None or age < min_age_s:
+                    live.add(ref_url.rstrip("/"))
+            continue
+        # Which of the child's base indices resolve to us?
+        own_idxs = {
+            i
+            for i, r in enumerate(md.base_paths)
+            if resolve_base_ref(r, ref_url).rstrip("/") == own
+        }
+        if own_idxs and any(
+            getattr(e, "base", None) in own_idxs
+            for e in _iter_payload_entries(md.manifest)
+        ):
+            live.add(ref_url.rstrip("/"))
+    return live
+
+
+async def _gc_backlinks_in_bases(
+    metadata: SnapshotMetadata, own_path: str
+) -> None:
+    """After deleting ``own_path``, remove the back-link markers it left
+    in its base snapshots' roots."""
+    from .incremental import referencing_snapshots
+
+    own = own_path.rstrip("/")
+    for ref in metadata.base_paths:
+        root = resolve_base_ref(ref, own_path)
+        base_storage = url_to_storage_plugin(root)
+        try:
+            for marker_path, ref_url in await referencing_snapshots(
+                base_storage, root
+            ):
+                if ref_url and ref_url.rstrip("/") == own:
+                    await _delete_ignore_missing(base_storage, marker_path)
+        except Exception as e:
+            logger.warning(f"back-link GC in {root} failed: {e!r}")
+        finally:
+            base_storage.close()
+
+
 # Canonical classifier lives in io_types (shared with the retry layer).
 _is_not_found_error = is_not_found_error
 
@@ -1352,10 +1697,36 @@ def _iter_payload_entries(manifest: Manifest):
     """Yield every manifest entry that references a stored payload object
     (a shard's ArrayEntry, a dense ArrayEntry, or an ObjectEntry) — THE
     definition of "what objects does this snapshot own", shared by
-    delete() and verify() so they can never disagree about it. The same
-    location may be yielded more than once (replicated paths appear once
-    per rank); callers dedup per their needs."""
-    for entry in manifest.values():
+    delete() and verify() so they can never disagree about it.
+
+    Replicated logical paths yield ONE canonical entry — the
+    checksum-bearing stripe owner's. Every rank's mirror describes the
+    same stored object, and after an incremental take the non-owner
+    mirrors are not even descriptive: the owner's entry may reference a
+    base snapshot's object while un-rewritten mirrors still name a
+    location in this snapshot's root that was never written — treating
+    those as payload objects would make verify()/copy_to() misread a
+    healthy snapshot as corrupt. Non-replicated sharded entries may
+    still yield the same location more than once (shard-union merges);
+    callers dedup per their needs."""
+    repl_pref: Dict[str, Entry] = {}
+    for path, entry in manifest.items():
+        if is_replicated(entry):
+            local = path.split("/", 1)[1] if "/" in path else path
+            current = repl_pref.get(local)
+            if current is None or (
+                _entry_has_checksum(entry)
+                and not _entry_has_checksum(current)
+            ):
+                repl_pref[local] = entry
+    emitted: Set[str] = set()
+    for path, entry in manifest.items():
+        if is_replicated(entry):
+            local = path.split("/", 1)[1] if "/" in path else path
+            if local in emitted:
+                continue
+            emitted.add(local)
+            entry = repl_pref[local]
         if isinstance(entry, ShardedArrayEntry):
             yield from (shard.array for shard in entry.shards)
         elif getattr(entry, "location", None):
@@ -1741,6 +2112,7 @@ def _gather_manifest(
     coordinator: Coordinator,
     local_manifest: Manifest,
     take_id: Optional[str] = None,
+    base_paths: Optional[List[str]] = None,
 ) -> SnapshotMetadata:
     """All-gather per-process manifests and merge (sync-take commit path)."""
     all_manifests = coordinator.all_gather_object(local_manifest)
@@ -1749,6 +2121,7 @@ def _gather_manifest(
         world_size=coordinator.get_world_size(),
         manifest=_merge_manifests(all_manifests),
         take_id=take_id,
+        base_paths=list(base_paths or []),
     )
 
 
@@ -1778,12 +2151,15 @@ async def _acommit_via_storage(
     world_size: int,
     manifest: Manifest,
     take_id: str,
+    base_paths: Optional[List[str]] = None,
 ) -> None:
     """Commit by completion markers: every rank writes its local manifest
     to ``.completed/<take_id>/<rank>``; rank 0 polls all markers, merges,
     writes the metadata document, and removes the markers. Shared by the
     async drain (always) and the sync path (large manifests). The caller
-    must barrier afterwards if it needs commit-before-return semantics."""
+    must barrier afterwards if it needs commit-before-return semantics.
+    ``base_paths`` is rank-deterministic (see apply_incremental), so
+    rank 0's copy standing in for everyone's is exact, not approximate."""
     marker = IOReq(path=f".completed/{take_id}/{rank}")
     marker.buf.write(
         _encode_metadata_doc(
@@ -1792,6 +2168,7 @@ async def _acommit_via_storage(
                 world_size=world_size,
                 manifest=manifest,
                 take_id=take_id,
+                base_paths=list(base_paths or []),
             ).to_yaml()
         )
     )
@@ -1805,6 +2182,7 @@ async def _acommit_via_storage(
             world_size=world_size,
             manifest=_merge_manifests(all_manifests),
             take_id=take_id,
+            base_paths=list(base_paths or []),
         )
         await _awrite_snapshot_metadata(storage, metadata)
         for r in range(world_size):
