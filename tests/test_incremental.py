@@ -470,6 +470,91 @@ def test_backlink_markers_idempotent_across_takes(tmp_path):
     assert len(markers) == 2, markers
 
 
+def _mgr_state(head_val: float):
+    return {"model": StateDict(
+        backbone=jnp.asarray(np.full(4096, 7.0, np.float32)),  # frozen
+        head=jnp.asarray(np.full(64, head_val, np.float32)),   # trains
+    )}
+
+
+def test_manager_incremental_end_to_end(tmp_path):
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, incremental=True)
+    for step in range(1, 5):
+        mgr.save(step, _mgr_state(float(step)))
+    # steps 3,4 retained by the window; step 1 (the frozen backbone's
+    # original writer) is DEFERRED, not silently dropped; step 2 holds
+    # nothing anyone references and is pruned.
+    assert mgr.all_steps() == [1, 3, 4]
+    # the incremental steps actually deduplicated: only the changed head
+    # was stored
+    assert _count_payload_files(str(tmp_path / "step-4")) == 1
+    fresh = _mgr_state(0.0)
+    assert mgr.restore(fresh) == 4
+    assert np.allclose(np.asarray(fresh["model"]["head"]), 4.0)
+    assert np.allclose(np.asarray(fresh["model"]["backbone"]), 7.0)
+
+
+def test_manager_full_period_unpins_bases(tmp_path):
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    mgr = CheckpointManager(
+        str(tmp_path), max_to_keep=2, incremental=True, full_period=2
+    )
+    for step in range(1, 6):
+        mgr.save(step, _mgr_state(float(step)))
+    # step 4 was a FULL take; step 5 bases on it. Nothing references
+    # steps 1-3 anymore, so the window holds exactly [4, 5].
+    assert mgr.all_steps() == [4, 5]
+    m5 = Snapshot(str(tmp_path / "step-5")).get_manifest()
+    assert m5["0/model/backbone"].base is not None  # still deduped vs 4
+    fresh = _mgr_state(0.0)
+    assert mgr.restore(fresh) == 5
+    assert np.allclose(np.asarray(fresh["model"]["head"]), 5.0)
+
+
+def test_manager_incremental_world2(tmp_path, caplog):
+    """Multi-rank managed incremental saves: non-zero ranks defer base
+    resolution to rank 0 via the sentinel — no divergence warnings, and
+    the dedup still lands."""
+    import logging
+
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    root = str(tmp_path)
+
+    def run(coord, rank):
+        mgr = CheckpointManager(root, incremental=True, coord=coord)
+        for step in (1, 2):
+            mgr.save(step, {"model": StateDict(
+                w=jnp.asarray(np.full(256, float(step), np.float32)),
+                frozen=jnp.asarray(np.full(512, 3.0, np.float32)),
+            )}, replicated=["**"])
+
+    with caplog.at_level(logging.WARNING):
+        _run_world(2, run)
+    assert not [r for r in caplog.records if "but rank 0" in r.message]
+    m = Snapshot(f"{root}/step-2").get_manifest()
+    assert m["0/model/frozen"].base is not None
+    assert m["0/model/w"].base is None
+    assert Snapshot(f"{root}/step-2").verify() == {}
+
+
+def test_manager_async_incremental(tmp_path):
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), incremental=True)
+    mgr.save(1, _mgr_state(1.0))
+    handle = mgr.async_save(2, _mgr_state(2.0))
+    assert handle.wait() is not None
+    assert mgr.all_steps() == [1, 2]
+    assert _count_payload_files(str(tmp_path / "step-2")) == 1
+    fresh = _mgr_state(0.0)
+    assert mgr.restore(fresh) == 2
+    assert np.allclose(np.asarray(fresh["model"]["head"]), 2.0)
+
+
 def test_rng_state_flows_through_incremental(tmp_path):
     from torchsnapshot_tpu import RNGState
 

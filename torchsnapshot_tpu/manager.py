@@ -35,7 +35,12 @@ from typing import Any, List, Optional
 
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .io_types import IOReq, is_not_found_error
-from .snapshot import _COMPLETION_TIMEOUT_S, PendingSnapshot, Snapshot
+from .snapshot import (
+    _COMPLETION_TIMEOUT_S,
+    BASE_FROM_RANK0,
+    PendingSnapshot,
+    Snapshot,
+)
 from .stateful import AppState
 from .storage_plugin import url_to_storage_plugin
 from .utils.env import env_float
@@ -71,12 +76,27 @@ class CheckpointManager:
         keep_period: Optional[int] = None,
         coord: Optional[Coordinator] = None,
         reconcile_on_init: Optional[str] = None,
+        incremental: bool = False,
+        full_period: Optional[int] = None,
     ) -> None:
         """``max_to_keep`` bounds retained checkpoints; ``keep_period``
         additionally ARCHIVES every checkpoint whose step is a multiple
         of it — archived steps never count against ``max_to_keep`` and
         are never pruned (the orbax retention contract: a rolling recent
         window plus periodic keepers for post-hoc evaluation).
+
+        ``incremental=True`` makes every ``save``/``async_save`` an
+        incremental take based on the latest committed step (see
+        incremental.py): unchanged arrays skip the device→host transfer
+        and the storage write entirely, so periodic checkpointing pays
+        for *changed* bytes only. Retention understands references: a
+        step that newer snapshots still borrow objects from is deferred
+        past ``max_to_keep`` (visibly, with a log line) until its last
+        referencer is pruned. ``full_period`` forces a FULL take every
+        time ``step %% full_period == 0``, bounding how long any old
+        base stays pinned — without it, a never-changing array keeps
+        its original writer retained for the whole run (which is
+        correct, merely unbounded).
 
         ``reconcile_on_init`` ("adopt" or "sweep") runs
         :meth:`reconcile` once at construction — the job-startup hook
@@ -88,6 +108,10 @@ class CheckpointManager:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         if keep_period is not None and keep_period < 1:
             raise ValueError(f"keep_period must be >= 1, got {keep_period}")
+        if full_period is not None and full_period < 1:
+            raise ValueError(f"full_period must be >= 1, got {full_period}")
+        if full_period is not None and not incremental:
+            raise ValueError("full_period requires incremental=True")
         if reconcile_on_init not in (None, "adopt", "sweep"):
             raise ValueError(
                 f"reconcile_on_init must be None, 'adopt', or 'sweep'; "
@@ -96,7 +120,14 @@ class CheckpointManager:
         self.base_path = base_path
         self.max_to_keep = max_to_keep
         self.keep_period = keep_period
+        self.incremental = incremental
+        self.full_period = full_period
         self._coord = coord
+        # Last step committed THROUGH this manager instance + its
+        # handle, reused as the next incremental base (seeded metadata
+        # cache: no per-take base-metadata GET on rank 0).
+        self._last_saved_step: Optional[int] = None
+        self._last_saved: Optional[Snapshot] = None
         if reconcile_on_init is not None:
             self.reconcile(adopt=(reconcile_on_init == "adopt"))
 
@@ -241,6 +272,32 @@ class CheckpointManager:
 
     # -------------------------------------------------------------- save
 
+    def _incremental_base(
+        self, step: int, coordinator: Coordinator
+    ) -> Optional[Any]:
+        """The base for an incremental save, or None for a full take.
+        Resolved on rank 0 only — other ranks pass the BASE_FROM_RANK0
+        sentinel (``Snapshot.take`` collates the base collectively with
+        rank 0 authoritative), so they need not list storage and can
+        never race a prune into a different answer. When the latest
+        step is the one this manager just committed, its retained
+        handle is passed instead of a path: the handle's seeded
+        metadata cache saves every take a base-metadata GET + parse."""
+        if not self.incremental:
+            return None
+        if coordinator.get_rank() != 0:
+            return BASE_FROM_RANK0
+        if self.full_period is not None and step % self.full_period == 0:
+            return None
+        latest = self.latest_step()
+        if latest is None or latest >= step:
+            # No committed base, or out-of-order/re-saved step numbers:
+            # take a full snapshot rather than reference "the future".
+            return None
+        if latest == self._last_saved_step and self._last_saved is not None:
+            return self._last_saved
+        return _step_dir(self.base_path, latest)
+
     def save(
         self,
         step: int,
@@ -256,8 +313,12 @@ class CheckpointManager:
             coord=coordinator,
             replicated=replicated,
             compression=compression,
+            base=self._incremental_base(step, coordinator),
+            fingerprint=True if self.incremental else None,
         )
         self._finalize(step, coordinator)
+        if coordinator.get_rank() == 0:
+            self._last_saved_step, self._last_saved = step, snapshot
         return snapshot
 
     def async_save(
@@ -280,6 +341,8 @@ class CheckpointManager:
             replicated=replicated,
             compression=compression,
             stage=stage,
+            base=self._incremental_base(step, coordinator),
+            fingerprint=True if self.incremental else None,
         )
         return PendingManagedSnapshot(self, step, pending, coordinator)
 
@@ -333,7 +396,12 @@ class CheckpointManager:
                 doomed.append(int(t[len(_PRUNING_PREFIX):]))
             except ValueError:
                 logger.warning(f"Ignoring malformed prune tombstone: {t}")
-        for step in sorted(set(doomed)):
+        # Newest-first: an incremental chain's referencers are always
+        # NEWER than their base, so pruning in reverse order releases a
+        # doomed base's back-links before its own reference check runs —
+        # one pass reclaims a whole doomed chain instead of deferring
+        # the base to the next prune.
+        for step in sorted(set(doomed), reverse=True):
             try:
                 # A step that live incremental snapshots still reference
                 # holds THEIR data: defer BEFORE tombstoning, so the
@@ -345,8 +413,17 @@ class CheckpointManager:
                     referenced = Snapshot(
                         _step_dir(self.base_path, step)
                     ).is_referenced()
-                except Exception:
-                    referenced = False  # delete() itself re-checks
+                except Exception as e:
+                    # Fail toward DEFER: proceeding would tombstone the
+                    # step and delete its marker before delete()'s own
+                    # re-check can refuse — leaving a live-referenced
+                    # step permanently invisible to the manager. A
+                    # deferred step just gets re-checked next prune.
+                    logger.warning(
+                        f"Prune of step {step}: reference check failed "
+                        f"({e!r}); deferring."
+                    )
+                    referenced = True
                 if referenced:
                     logger.info(
                         f"Prune of step {step} deferred: still "
@@ -441,4 +518,7 @@ class PendingManagedSnapshot:
             # step's commit.
             self._manager._finalize(self._step, self._coordinator)
             self._finalized = True
+            if self._coordinator.get_rank() == 0:
+                self._manager._last_saved_step = self._step
+                self._manager._last_saved = snapshot
         return snapshot

@@ -160,7 +160,7 @@ class Snapshot:
         storage = url_to_storage_plugin(path)
         try:
             with tracing.span("Snapshot.take", path=path):
-                cls._take_impl(
+                merged = cls._take_impl(
                     path=path,
                     app_state=app_state,
                     coordinator=coordinator,
@@ -174,7 +174,14 @@ class Snapshot:
                 )
         finally:
             storage.close()
-        return cls(path=path, coord=coord)
+        snapshot = cls(path=path, coord=coord)
+        if merged is not None:
+            # Rank 0 built the merged metadata during the commit; seed
+            # the handle's cache (decorated, exactly as a storage load
+            # would be) so using this handle as the NEXT incremental
+            # take's base costs no metadata GET + parse.
+            snapshot._metadata_cache = _decorate_metadata_refs(merged)
+        return snapshot
 
     @classmethod
     def async_take(
@@ -255,7 +262,10 @@ class Snapshot:
         base_path: Optional[str] = None,
         fingerprint: Optional[bool] = None,
         base_metadata: Optional[SnapshotMetadata] = None,
-    ) -> None:
+    ) -> Optional[SnapshotMetadata]:
+        # Returns the merged metadata when this process holds it after
+        # the commit (sync takes; all ranks on the KV route, rank 0 on
+        # the storage route) so the caller can seed its handle's cache.
         app_state = dict(app_state)
         rank = coordinator.get_rank()
         rng_key, rng_stateful = _pop_rng_state(app_state)
@@ -339,6 +349,7 @@ class Snapshot:
                         stager.kickoff_host_copy()
 
         budget = get_process_memory_budget_bytes(coordinator)
+        merged_metadata: Optional[SnapshotMetadata] = None
 
         if background is None:
             asyncio.run(
@@ -377,7 +388,7 @@ class Snapshot:
                 # finished, preserving metadata-last ordering. The final
                 # barrier holds every rank until rank 0's metadata write
                 # (its barrier key is set only after asyncio.run returns).
-                asyncio.run(
+                merged_metadata = asyncio.run(
                     _acommit_via_storage(
                         storage,
                         rank,
@@ -400,6 +411,9 @@ class Snapshot:
                 )
                 if rank == 0:
                     _write_snapshot_metadata(storage, metadata)
+                # The all-gather gave EVERY rank the merged view; the
+                # caller seeds its handle's cache with it.
+                merged_metadata = metadata
             # Rank 0 holds this barrier until its metadata write (and, on
             # the storage route, the O(world) marker collection under
             # _COMPLETION_TIMEOUT_S) finishes — which can legitimately
@@ -464,6 +478,7 @@ class Snapshot:
         # snapshot.py:216-221).
         if rng_stateful is not None and rng_captured is not None:
             rng_stateful.load_state_dict(rng_captured)
+        return merged_metadata
 
     # --------------------------------------------------------------- restore
 
@@ -610,30 +625,21 @@ class Snapshot:
                         f"({e!r}); proceeding with sweep-only delete."
                     )
                 metadata = None  # uncommitted/corrupt take: sweep-only
-            # The in-flight-take marker guard has its OWN age knob: tests
-            # and ops runbooks set TPUSNAPSHOT_SWEEP_MIN_AGE_S=0 to force
-            # unconditional sweeps, and that must not silently disable
-            # the protection against deleting a base mid-child-take.
-            try:
-                refs_min_age_s = float(
-                    os.environ.get("TPUSNAPSHOT_REFS_MIN_AGE_S", 3600)
+            if not force:
+                # force=True skips the scan entirely — its only output
+                # is the refusal the caller explicitly overrode, and on
+                # a long-lived base it costs one metadata GET per child.
+                refs = asyncio.run(
+                    _live_referencers(storage, self.path, _refs_min_age_s())
                 )
-            except ValueError as e:
-                raise ValueError(
-                    f"Malformed TPUSNAPSHOT_REFS_MIN_AGE_S="
-                    f"{os.environ['TPUSNAPSHOT_REFS_MIN_AGE_S']!r}: "
-                    f"expected seconds as a number"
-                ) from e
-            refs = asyncio.run(
-                _live_referencers(storage, self.path, refs_min_age_s)
-            )
-            if refs and not force:
-                raise RuntimeError(
-                    f"Snapshot {self.path} is still referenced by "
-                    f"incremental snapshot(s) {sorted(refs)}; deleting it "
-                    f"would corrupt them. Delete (or copy_to-materialize) "
-                    f"those first, or pass force=True."
-                )
+                if refs:
+                    raise RuntimeError(
+                        f"Snapshot {self.path} is still referenced by "
+                        f"incremental snapshot(s) {sorted(refs)}; deleting "
+                        f"it would corrupt them. Delete (or "
+                        f"copy_to-materialize) those first, or pass "
+                        f"force=True."
+                    )
             locations: Set[str] = set()
             markers: List[str] = []
             if metadata is not None:
@@ -650,7 +656,9 @@ class Snapshot:
                     if metadata.take_id
                 ]
             # Our own back-link markers (refs/ in OUR prefix) go with us.
-            own_markers = asyncio.run(storage.list_prefix("refs/"))
+            from .incremental import REFS_PREFIX
+
+            own_markers = asyncio.run(storage.list_prefix(REFS_PREFIX))
             if own_markers:
                 markers = markers + list(own_markers)
 
@@ -735,17 +743,11 @@ class Snapshot:
         snapshot's objects (see ``delete``'s incremental-safety notes).
         Retention policies should treat a referenced snapshot as
         holding live data: defer its deletion rather than force it."""
-        try:
-            refs_min_age_s = float(
-                os.environ.get("TPUSNAPSHOT_REFS_MIN_AGE_S", 3600)
-            )
-        except ValueError:
-            refs_min_age_s = 3600.0
         storage = self._open_storage()
         try:
             return bool(
                 asyncio.run(
-                    _live_referencers(storage, self.path, refs_min_age_s)
+                    _live_referencers(storage, self.path, _refs_min_age_s())
                 )
             )
         finally:
@@ -1229,17 +1231,7 @@ class Snapshot:
             metadata = SnapshotMetadata.from_yaml(
                 _decode_metadata_doc(bytes(io_payload(io_req)))
             )
-            # Decorate incremental references ONCE (cache-guarded):
-            # entries whose payload lives in a base snapshot get routed
-            # locations, so every downstream path — restore, verify,
-            # copy_to, read_object — resolves them through the router
-            # with no further special-casing.
-            if metadata.base_paths:
-                for e in _iter_payload_entries(metadata.manifest):
-                    base_idx = getattr(e, "base", None)
-                    if base_idx is not None and not is_ref_location(e.location):
-                        e.location = make_ref_location(base_idx, e.location)
-            self._metadata_cache = metadata
+            self._metadata_cache = _decorate_metadata_refs(metadata)
         metadata = self._metadata_cache
         if metadata.base_paths and isinstance(storage, RefRouterPlugin):
             # Attach per-storage-instance (the cache outlives any one
@@ -1357,13 +1349,20 @@ class PendingSnapshot:
 # ------------------------------------------------------------------ helpers
 
 
-def _resolve_base_arg(base: Optional[Any], path: str) -> Optional[str]:
+# Sentinel ``base`` value for callers that resolve the base on rank 0
+# only (CheckpointManager): other ranks pass this instead of None, which
+# both documents the intent and keeps the divergence warning quiet —
+# deferring to rank 0 IS the protocol, not a bug to warn about.
+BASE_FROM_RANK0 = object()
+
+
+def _resolve_base_arg(base: Optional[Any], path: str) -> Optional[Any]:
     """Normalize take's ``base`` argument (a Snapshot or a path string).
     Never raises: validation happens AFTER the collation collective, so
     every rank raises (or proceeds) uniformly — a pre-collective raise
     on one rank would strand its peers in the broadcast."""
-    if base is None:
-        return None
+    if base is None or base is BASE_FROM_RANK0:
+        return base
     return base.path if isinstance(base, Snapshot) else str(base)
 
 
@@ -1385,7 +1384,7 @@ def _reusable_base_metadata(
 
 def _collate_incremental_args(
     coordinator: Coordinator,
-    base_path: Optional[str],
+    base_path: Optional[Any],
     fingerprint: Optional[bool],
 ) -> Tuple[Optional[str], Optional[bool]]:
     """Make ``base``/``fingerprint`` collective like ``path``: rank 0's
@@ -1393,12 +1392,15 @@ def _collate_incremental_args(
     nicety — entry ``base`` indices resolve against the MERGED
     metadata's base_paths (rank 0's namespace), so a rank deduping
     against a different base would commit references that resolve to
-    the wrong snapshot's bytes."""
-    collated = coordinator.broadcast_object((base_path, fingerprint), src=0)
-    if collated != (base_path, fingerprint):
+    the wrong snapshot's bytes. Ranks passing ``BASE_FROM_RANK0``
+    opted into rank 0's answer by protocol — no warning."""
+    deferred = base_path is BASE_FROM_RANK0
+    local = (None if deferred else base_path, fingerprint)
+    collated = coordinator.broadcast_object(local, src=0)
+    if not deferred and collated != local:
         logger.warning(
             f"Rank {coordinator.get_rank()} passed "
-            f"(base={base_path!r}, fingerprint={fingerprint!r}) but rank 0 "
+            f"(base={local[0]!r}, fingerprint={local[1]!r}) but rank 0 "
             f"passed (base={collated[0]!r}, fingerprint={collated[1]!r}). "
             f"Using rank 0's."
         )
@@ -1603,6 +1605,37 @@ async def _delete_ignore_missing(storage: StoragePlugin, path: str) -> None:
     except Exception as e:
         if not _is_not_found_error(e):
             raise
+
+
+def _decorate_metadata_refs(metadata: SnapshotMetadata) -> SnapshotMetadata:
+    """Decorate incremental references ONCE per in-memory metadata:
+    entries whose payload lives in a base snapshot get routed
+    ("@base<N>/…") locations, so every downstream path — restore,
+    verify, copy_to, read_object — resolves them through the router
+    with no further special-casing. Idempotent."""
+    if metadata.base_paths:
+        for e in _iter_payload_entries(metadata.manifest):
+            base_idx = getattr(e, "base", None)
+            if base_idx is not None and not is_ref_location(e.location):
+                e.location = make_ref_location(base_idx, e.location)
+    return metadata
+
+
+def _refs_min_age_s() -> float:
+    """The in-flight-take marker guard's age knob. Deliberately its OWN
+    knob: tests and ops runbooks set TPUSNAPSHOT_SWEEP_MIN_AGE_S=0 to
+    force unconditional sweeps, and that must not silently disable the
+    protection against deleting a base mid-child-take. Malformed values
+    raise (the sweep knob's parse-before-destructive-work contract);
+    retention callers catch and defer."""
+    raw = os.environ.get("TPUSNAPSHOT_REFS_MIN_AGE_S", 3600)
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"Malformed TPUSNAPSHOT_REFS_MIN_AGE_S={raw!r}: expected "
+            f"seconds as a number"
+        ) from e
 
 
 async def _aread_metadata_at(url: str) -> SnapshotMetadata:
@@ -2152,14 +2185,15 @@ async def _acommit_via_storage(
     manifest: Manifest,
     take_id: str,
     base_paths: Optional[List[str]] = None,
-) -> None:
+) -> Optional[SnapshotMetadata]:
     """Commit by completion markers: every rank writes its local manifest
     to ``.completed/<take_id>/<rank>``; rank 0 polls all markers, merges,
     writes the metadata document, and removes the markers. Shared by the
     async drain (always) and the sync path (large manifests). The caller
     must barrier afterwards if it needs commit-before-return semantics.
     ``base_paths`` is rank-deterministic (see apply_incremental), so
-    rank 0's copy standing in for everyone's is exact, not approximate."""
+    rank 0's copy standing in for everyone's is exact, not approximate.
+    Returns the merged metadata on rank 0 (None elsewhere)."""
     marker = IOReq(path=f".completed/{take_id}/{rank}")
     marker.buf.write(
         _encode_metadata_doc(
@@ -2190,6 +2224,8 @@ async def _acommit_via_storage(
                 await storage.delete(f".completed/{take_id}/{r}")
             except Exception:
                 pass  # best-effort cleanup
+        return metadata
+    return None
 
 
 async def _awrite_snapshot_metadata(
