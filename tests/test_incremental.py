@@ -555,6 +555,123 @@ def test_manager_async_incremental(tmp_path):
     assert np.allclose(np.asarray(fresh["model"]["head"]), 2.0)
 
 
+def test_diff_reports_changed_and_unchanged(tmp_path):
+    app = {"model": StateDict(
+        w=jnp.arange(128, dtype=jnp.float32),
+        b=jnp.ones(16, jnp.float32),
+        lr=0.1,
+    )}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    app["model"]["b"] = app["model"]["b"] + 1.0
+    app["model"]["lr"] = 0.01
+    del app["model"]["w"]
+    app["model"]["new"] = jnp.zeros(4, jnp.float32)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, fingerprint=True)
+    d = s2.diff(s1)
+    assert d["added"] == ["model/new"]
+    assert d["removed"] == ["model/w"]
+    assert sorted(d["changed"]) == ["model/b", "model/lr"]
+    assert d["unchanged"] == []
+    # identical snapshots diff clean
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2)
+    d2 = s3.diff(s2)
+    assert not d2["added"] and not d2["removed"] and not d2["changed"]
+    assert sorted(d2["unchanged"]) == ["model/b", "model/lr", "model/new"]
+
+
+def test_diff_without_fingerprints_uses_checksums(tmp_path):
+    app = {"model": StateDict(w=jnp.arange(128, dtype=jnp.float32))}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app)
+    d = s2.diff(s1)
+    assert d["unchanged"] == ["model/w"]  # equal crc32 of logical bytes
+    app["model"]["w"] = app["model"]["w"] + 1
+    s3 = Snapshot.take(str(tmp_path / "s3"), app)
+    assert s3.diff(s1)["changed"] == ["model/w"]
+
+
+def test_diff_sharded_region_granular(tmp_path):
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]).reshape(8), ("dp",))
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp")
+    )
+    x = jax.device_put(np.ones((8, 16), np.float32), sharding)
+    app = {"model": StateDict(emb=x)}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    host = np.asarray(x).copy()
+    host[2] = 5.0
+    app["model"]["emb"] = jax.device_put(host, sharding)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, fingerprint=True)
+    assert s2.diff(s1)["changed"] == ["model/emb"]
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, fingerprint=True)
+    assert s3.diff(s2)["unchanged"] == ["model/emb"]
+
+
+def test_inspect_diff_cli(tmp_path, capsys):
+    from torchsnapshot_tpu.inspect import main
+
+    app = {"model": StateDict(w=jnp.arange(32, dtype=jnp.float32))}
+    Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    app["model"]["w"] = app["model"]["w"] * 2
+    Snapshot.take(str(tmp_path / "s2"), app, fingerprint=True)
+    rc = main([str(tmp_path / "s2"), "--diff", str(tmp_path / "s1")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "changed" in out and "model/w" in out
+    rc = main([str(tmp_path / "s1"), "--diff", str(tmp_path / "s1")])
+    assert rc == 0
+
+
+def test_restore_verify_device_passes_and_catches_corruption(tmp_path):
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    s1.restore(fresh, verify_device=True)  # clean path
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+    # Corrupt the manifest's recorded fingerprint to simulate restored
+    # bytes not matching what the snapshot recorded.
+    meta = s1._read_snapshot_metadata(s1._open_storage())
+    meta.manifest["0/model/w"].fingerprint = "xs128:" + "f" * 32
+    with pytest.raises(RuntimeError, match="model/w"):
+        s1.restore(fresh, verify_device=True)
+
+
+def test_restore_verify_device_sharded(tmp_path):
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]).reshape(8), ("dp",))
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp")
+    )
+    x = jax.device_put(
+        np.arange(8 * 32, dtype=np.float32).reshape(8, 32), sharding
+    )
+    app = {"model": StateDict(emb=x)}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    fresh = {"model": StateDict(emb=jax.device_put(
+        np.zeros((8, 32), np.float32), sharding))}
+    s1.restore(fresh, verify_device=True)
+    assert np.array_equal(np.asarray(fresh["model"]["emb"]), np.asarray(x))
+
+
+def test_restore_verify_device_skips_unfingerprinted(tmp_path, caplog):
+    import logging
+
+    app = {"model": _state()}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app)  # no fingerprints
+    fresh = {"model": StateDict(w=jnp.zeros(1024, jnp.float32),
+                                b=np.zeros(32, np.float32), step=0)}
+    with caplog.at_level(logging.INFO):
+        s1.restore(fresh, verify_device=True)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.asarray(app["model"]["w"]))
+
+
 def test_rng_state_flows_through_incremental(tmp_path):
     from torchsnapshot_tpu import RNGState
 

@@ -260,6 +260,7 @@ def apply_incremental(
     base_path: Optional[str],
     record_fingerprints: bool,
     base_metadata: Optional[SnapshotMetadata] = None,
+    coordinator: Optional[Any] = None,
 ) -> Tuple[List[str], IncrementalStats]:
     """Fingerprint array payloads and (when ``base_path`` is given)
     dedup unchanged ones against the base snapshot.
@@ -269,9 +270,12 @@ def apply_incremental(
     ``write_reqs``. Returns the ``base_paths`` list for this take's
     metadata (empty when no base) and the dedup stats. Runs BEFORE
     staging/cloning, so a dedup hit skips the device→host transfer, the
-    storage write, and (async takes) the device clone. No collectives —
-    per-rank divergence in hit counts is fine; the reference namespace
-    itself is rank-deterministic.
+    storage write, and (async takes) the device clone. Per-rank
+    divergence in hit counts is fine; the reference namespace itself is
+    rank-deterministic. With a base, ONE collective runs (a kilobyte
+    gather of used base indices) so rank 0 alone writes the union's
+    back-link markers — N ranks PUTting the same idempotent object
+    concurrently would trip same-object rate limits on cloud backends.
     """
     stats = IncrementalStats()
     if base_path is None and not record_fingerprints:
@@ -361,6 +365,16 @@ def apply_incremental(
             for wr in write_reqs
             if id(getattr(wr.buffer_stager, "_entry", None)) not in dropped
         ]
+    # The marker gather is UNCONDITIONAL under a base (hit counts may
+    # diverge across ranks, collective participation must not).
+    if coordinator is not None and coordinator.get_world_size() > 1:
+        gathered = coordinator.all_gather_object(sorted(used_idxs))
+        union = set()
+        for idxs in gathered:
+            union.update(idxs)
+        if rank == 0 and union:
+            _write_back_link(ctx, own_path, rank, union)
+    elif used_idxs:
         _write_back_link(ctx, own_path, rank, used_idxs)
     stats.written = len(write_reqs)
     if stats.dedup_hits:

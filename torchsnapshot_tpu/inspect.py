@@ -106,6 +106,14 @@ def main(argv=None) -> int:
         "gs://bucket/path), verifying every payload checksum in "
         "transit; the destination commits metadata-last",
     )
+    parser.add_argument(
+        "--diff",
+        metavar="OLDER",
+        help="content-diff PATH against the OLDER snapshot: which "
+        "logical paths were added/removed/changed/unchanged (exact when "
+        "both takes recorded fingerprints); metadata-only, no payload "
+        "reads; exit 1 when anything changed",
+    )
     args = parser.parse_args(argv)
 
     exclusive = [
@@ -115,12 +123,29 @@ def main(argv=None) -> int:
         bool(args.steps),
         bool(args.reconcile),
         bool(args.copy_to),
+        bool(args.diff),
     ]
     if sum(exclusive) > 1:
         parser.error(
             "--verify, --delete/--sweep, --convert-back, --steps, "
-            "--reconcile, and --copy-to are mutually exclusive; run "
-            "them in separate invocations"
+            "--reconcile, --copy-to, and --diff are mutually exclusive; "
+            "run them in separate invocations"
+        )
+    if args.diff:
+        result = Snapshot(args.path).diff(args.diff, rank=args.rank)
+        for kind in ("added", "removed", "changed", "unknown"):
+            for p in result[kind]:
+                print(f"{kind:>9}  {p}")
+        print(
+            f"{len(result['added'])} added, {len(result['removed'])} "
+            f"removed, {len(result['changed'])} changed, "
+            f"{len(result['unchanged'])} unchanged, "
+            f"{len(result['unknown'])} unknown"
+        )
+        return (
+            1
+            if (result["added"] or result["removed"] or result["changed"])
+            else 0
         )
     if args.copy_to:
         Snapshot(args.path).copy_to(args.copy_to)

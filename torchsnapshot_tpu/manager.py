@@ -285,10 +285,21 @@ class CheckpointManager:
         metadata cache saves every take a base-metadata GET + parse."""
         if not self.incremental:
             return None
-        if coordinator.get_rank() != 0:
-            return BASE_FROM_RANK0
         if self.full_period is not None and step % self.full_period == 0:
+            # step is collective, so every rank resolves "full take"
+            # here without waiting for rank 0's broadcast.
             return None
+        if coordinator.get_rank() != 0:
+            # Ranks != 0 avoid the storage listing; when they hold the
+            # handle of the step this manager just committed they pass
+            # it — rank 0's collated answer will normally name the same
+            # path and the handle's seeded metadata cache saves this
+            # rank the multi-MB base-metadata GET + parse. If rank 0
+            # resolves differently (stale manager, out-of-order step)
+            # the collation wins and this rank reads from storage.
+            if self._last_saved is not None:
+                return self._last_saved
+            return BASE_FROM_RANK0
         latest = self.latest_step()
         if latest is None or latest >= step:
             # No committed base, or out-of-order/re-saved step numbers:
@@ -317,8 +328,11 @@ class CheckpointManager:
             fingerprint=True if self.incremental else None,
         )
         self._finalize(step, coordinator)
-        if coordinator.get_rank() == 0:
-            self._last_saved_step, self._last_saved = step, snapshot
+        # Every rank retains the handle: sync KV-route commits seed ALL
+        # ranks' handle caches with the merged metadata, so the next
+        # incremental save skips the base-metadata GET on every rank,
+        # not just rank 0.
+        self._last_saved_step, self._last_saved = step, snapshot
         return snapshot
 
     def async_save(
@@ -518,7 +532,6 @@ class PendingManagedSnapshot:
             # step's commit.
             self._manager._finalize(self._step, self._coordinator)
             self._finalized = True
-            if self._coordinator.get_rank() == 0:
-                self._manager._last_saved_step = self._step
-                self._manager._last_saved = snapshot
+            self._manager._last_saved_step = self._step
+            self._manager._last_saved = snapshot
         return snapshot

@@ -68,10 +68,13 @@ from .io_types import (
     is_range_not_satisfiable_error,
 )
 from .manifest import (
+    ArrayEntry,
     DictEntry,
     Entry,
     ListEntry,
     Manifest,
+    ObjectEntry,
+    PrimitiveEntry,
     ShardedArrayEntry,
     SnapshotMetadata,
     get_available_entries,
@@ -154,7 +157,7 @@ class Snapshot:
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
         base_path, fingerprint = _collate_incremental_args(
-            coordinator, _resolve_base_arg(base, path), fingerprint
+            coordinator, _resolve_base_arg(base), fingerprint
         )
         _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
@@ -222,7 +225,7 @@ class Snapshot:
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
         base_path, fingerprint = _collate_incremental_args(
-            coordinator, _resolve_base_arg(base, path), fingerprint
+            coordinator, _resolve_base_arg(base), fingerprint
         )
         _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
@@ -338,6 +341,7 @@ class Snapshot:
                     base_path=base_path,
                     record_fingerprints=fingerprint_enabled,
                     base_metadata=base_metadata,
+                    coordinator=coordinator if base_path is not None else None,
                 )
             if background is None and base_path is not None:
                 # Sync takes suppressed prepare-time eager D2H copies so
@@ -487,6 +491,7 @@ class Snapshot:
         app_state: AppState,
         coord: Optional[Coordinator] = None,
         paths: Optional[List[str]] = None,
+        verify_device: bool = False,
     ) -> None:
         """Restore ``app_state`` in place from this snapshot.
 
@@ -496,6 +501,14 @@ class Snapshot:
         optimizer state); non-matching leaves keep their current values.
         Globs use the same namespace as ``replicated`` and
         :meth:`read_object`: ``"<stateful_key>/<flattened/path>"``.
+
+        ``verify_device=True`` (beyond parity) recomputes each restored
+        array's content fingerprint ON DEVICE and checks it against the
+        manifest — extending the integrity chain past the storage
+        checksum (which covers storage→host) all the way into HBM, at
+        device memory bandwidth. Leaves whose entries carry no
+        fingerprint (snapshots taken without ``fingerprint=True``) are
+        skipped; a mismatch raises with the offending paths.
         """
         coordinator = get_coordinator(coord if coord is not None else self._coord)
         rank = coordinator.get_rank()
@@ -503,12 +516,16 @@ class Snapshot:
         try:
             with tracing.span("Snapshot.restore", path=self.path):
                 return self._restore_impl(
-                    app_state, coordinator, rank, storage, paths
+                    app_state, coordinator, rank, storage, paths,
+                    verify_device=verify_device,
                 )
         finally:
             storage.close()
 
-    def _restore_impl(self, app_state, coordinator, rank, storage, paths):
+    def _restore_impl(
+        self, app_state, coordinator, rank, storage, paths,
+        verify_device: bool = False,
+    ):
         # The restore() wrapper owns the storage plugin's lifetime.
         metadata = self._read_snapshot_metadata(storage)
         available = get_available_entries(metadata.manifest, rank)
@@ -519,6 +536,7 @@ class Snapshot:
         global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
         budget = get_process_memory_budget_bytes(coordinator)
         n_selected = 0
+        verify_jobs: List[Tuple[str, Entry, Any]] = []
         for key in global_keys:
             stateful = app_state.get(key)
             if stateful is not None:
@@ -532,6 +550,7 @@ class Snapshot:
                     world_size=coordinator.get_world_size(),
                     snapshot_world_size=metadata.world_size,
                     path_globs=paths,
+                    verify_jobs_out=verify_jobs if verify_device else None,
                 )
             coordinator.barrier()
 
@@ -548,6 +567,14 @@ class Snapshot:
                 world_size=coordinator.get_world_size(),
                 snapshot_world_size=metadata.world_size,
                 path_globs=paths,
+                verify_jobs_out=verify_jobs if verify_device else None,
+            )
+        if verify_device:
+            verified, skipped = _verify_restored_fingerprints(verify_jobs)
+            logger.info(
+                f"restore(verify_device=True): {verified} leaf/leaves "
+                f"fingerprint-verified on device, {skipped} skipped "
+                f"(no recorded fingerprint)."
             )
         if paths is not None and n_selected == 0:
             # A filter that matches nothing is almost certainly a typo
@@ -737,6 +764,46 @@ class Snapshot:
                     logger.warning(f"back-link marker GC failed: {e!r}")
         finally:
             storage.close()
+
+    def diff(self, other: Any, rank: int = 0) -> Dict[str, List[str]]:
+        """Content diff against another snapshot (beyond parity): which
+        logical paths were ``added``/``removed``/``changed``/
+        ``unchanged`` between ``other`` (the older snapshot) and
+        ``self``, plus ``unknown`` where neither fingerprints nor
+        checksums allow a verdict. Storage-only and collective-free —
+        metadata reads, no payload IO: fingerprints recorded at take
+        time (``fingerprint=True`` / manager incremental mode) make the
+        comparison exact per leaf, shard-granular for sharded values.
+
+        The ops companion to incremental takes: "what actually changed
+        between step A and step B" without downloading either.
+        """
+        other_snap = other if isinstance(other, Snapshot) else Snapshot(str(other))
+        mine = get_available_entries(self.get_manifest(), rank)
+        theirs = get_available_entries(other_snap.get_manifest(), rank)
+
+        def _is_container(e: Entry) -> bool:
+            return isinstance(e, (ListEntry, DictEntry))
+
+        out: Dict[str, List[str]] = {
+            "added": [],
+            "removed": [],
+            "changed": [],
+            "unchanged": [],
+            "unknown": [],
+        }
+        for path in sorted(set(mine) | set(theirs)):
+            a, b = theirs.get(path), mine.get(path)
+            if a is not None and _is_container(a) and b is not None and _is_container(b):
+                continue  # structure shows through its leaves
+            if b is None or (a is not None and _is_container(b)):
+                out["removed"].append(path)
+                continue
+            if a is None or _is_container(a):
+                out["added"].append(path)
+                continue
+            out[_diff_verdict(a, b)].append(path)
+        return out
 
     def is_referenced(self) -> bool:
         """Whether a live incremental snapshot still references this
@@ -1356,7 +1423,7 @@ class PendingSnapshot:
 BASE_FROM_RANK0 = object()
 
 
-def _resolve_base_arg(base: Optional[Any], path: str) -> Optional[Any]:
+def _resolve_base_arg(base: Optional[Any]) -> Optional[Any]:
     """Normalize take's ``base`` argument (a Snapshot or a path string).
     Never raises: validation happens AFTER the collation collective, so
     every rank raises (or proceeds) uniformly — a pre-collective raise
@@ -2028,6 +2095,7 @@ def _load_stateful(
     world_size: int,
     snapshot_world_size: int,
     path_globs: Optional[List[str]] = None,
+    verify_jobs_out: Optional[List[Tuple[str, Entry, Any]]] = None,
 ) -> int:
     """Returns the number of leaves restored (callers detect no-op filters)."""
     # In-place restore strategy (reference snapshot.py:374-381): the
@@ -2084,6 +2152,14 @@ def _load_stateful(
     for finalize in finalizers:
         finalize()
 
+    if verify_jobs_out is not None:
+        for logical_path in sorted(selected):
+            entry = available.get(logical_path)
+            if isinstance(entry, (ArrayEntry, ShardedArrayEntry)):
+                verify_jobs_out.append(
+                    (logical_path, entry, flattened[logical_path])
+                )
+
     # Prefer the snapshot's container entries for inflation so saved
     # structure (e.g. dict key sets) round-trips; fall back to the
     # template's for paths the snapshot lacks. Partial restores keep the
@@ -2101,6 +2177,194 @@ def _load_stateful(
     new_state_dict = inflate(inflate_manifest, flattened, prefix=key)
     stateful.load_state_dict(new_state_dict)
     return len(selected)
+
+
+def _diff_verdict(a: Entry, b: Entry) -> str:
+    """Compare one logical path's entries across two snapshots.
+    ``a`` is the older snapshot's entry, ``b`` the newer's."""
+    if type(a) is not type(b):
+        return "changed"
+    if isinstance(a, PrimitiveEntry):
+        return "unchanged" if a.readable == b.readable else "changed"
+    if isinstance(a, ArrayEntry):
+        if (
+            a.dtype != b.dtype
+            or list(a.shape) != list(b.shape)
+            or a.prng_impl != b.prng_impl
+        ):
+            return "changed"
+        if a.fingerprint and b.fingerprint:
+            return "unchanged" if a.fingerprint == b.fingerprint else "changed"
+        if (
+            a.checksum
+            and b.checksum
+            and a.compression == b.compression
+        ):
+            # Equal checksums of equal-dtype/shape payloads: unchanged.
+            # Differing checksums are only "changed" when both are
+            # uncompressed crc32 of the logical bytes.
+            if a.checksum == b.checksum:
+                return "unchanged"
+            if a.compression is None:
+                return "changed"
+        return "unknown"
+    if isinstance(a, ShardedArrayEntry):
+        if a.dtype != b.dtype or list(a.shape) != list(b.shape):
+            return "changed"
+        regions_a = {
+            (tuple(s.offsets), tuple(s.sizes)): s.array for s in a.shards
+        }
+        regions_b = {
+            (tuple(s.offsets), tuple(s.sizes)): s.array for s in b.shards
+        }
+        if set(regions_a) != set(regions_b):
+            return "unknown"  # re-laid-out: no per-region comparison
+        verdicts = {
+            _diff_verdict(regions_a[k], regions_b[k]) for k in regions_a
+        }
+        if "changed" in verdicts:
+            return "changed"
+        if "unknown" in verdicts:
+            return "unknown"
+        return "unchanged"
+    if isinstance(a, ObjectEntry):
+        if a.checksum and b.checksum and a.compression == b.compression:
+            if a.checksum == b.checksum:
+                return "unchanged"
+            if a.compression is None:
+                return "changed"
+        return "unknown"
+    return "unknown"
+
+
+def _verify_restored_fingerprints(
+    jobs: List[Tuple[str, Entry, Any]]
+) -> Tuple[int, int]:
+    """Device-side integrity tail of ``restore(verify_device=True)``:
+    recompute each restored region's xs128 fingerprint where the
+    manifest recorded one, and compare. The storage checksum already
+    guards storage→host; this closes host→HBM (a DMA fault, a buggy
+    assembly path, or an addressing bug in resharding shows up here at
+    memory bandwidth, not in a diverging loss curve days later). All
+    device computations dispatch before the first result is fetched.
+
+    Assumes host- and device-computed fingerprints agree (bit-identical
+    on the CPU and TPU platforms tested; see fingerprint.py) — relevant
+    only when a leaf changed domains between take and restore.
+    Fingerprint-less entries are skipped, never failed.
+    """
+    import numpy as _np
+
+    import jax as _jax
+
+    from .fingerprint import (
+        fingerprint_device_async,
+        fingerprint_host,
+        format_fingerprint,
+    )
+
+    pending: List[Tuple[str, str, Any]] = []
+    skipped = 0
+    for path, entry, value in jobs:
+        if isinstance(entry, ShardedArrayEntry):
+            specs = [
+                (
+                    tuple(
+                        slice(o, o + s)
+                        for o, s in zip(sh.offsets, sh.sizes)
+                    ),
+                    sh.array.fingerprint,
+                )
+                for sh in entry.shards
+            ]
+        else:
+            specs = [(None, entry.fingerprint)]
+        data = value
+        if entry.prng_impl is not None and isinstance(value, _jax.Array):
+            try:
+                data = _jax.random.key_data(value)
+            except Exception:
+                pass  # already key data (or host-side): fingerprint as-is
+        for slices, expected in specs:
+            if expected is None:
+                skipped += 1
+                continue
+            try:
+                if isinstance(data, _jax.Array):
+                    pending.append(
+                        (path, expected, fingerprint_device_async(data, slices))
+                    )
+                else:
+                    host = _np.asarray(data)
+                    if slices is not None:
+                        host = host[slices]
+                    pending.append(
+                        (
+                            path,
+                            expected,
+                            fingerprint_host(_np.ascontiguousarray(host)),
+                        )
+                    )
+            except Exception as e:
+                logger.warning(
+                    f"verify_device: cannot fingerprint {path}: {e!r}; "
+                    f"skipping"
+                )
+                skipped += 1
+    verified = 0
+    mismatched: List[str] = []
+    soft_mismatched: List[str] = []
+    dtype_by_path = {
+        path: (
+            entry.shards[0].array.dtype
+            if isinstance(entry, ShardedArrayEntry) and entry.shards
+            else getattr(entry, "dtype", None)
+        )
+        for path, entry, _ in jobs
+    }
+    for path, expected, result in pending:
+        actual = (
+            result
+            if isinstance(result, str)
+            else format_fingerprint(_np.asarray(result))
+        )
+        if actual == expected:
+            verified += 1
+            continue
+        # fingerprint.py's determinism contract: the uint32 word view of
+        # a 4-byte dtype is a pure bit-pattern reinterpretation, stable
+        # everywhere — a mismatch there IS corruption. Sub-4-byte and
+        # 8-byte dtypes pack words through a platform/jax-version-
+        # dependent bitcast group order, so a mismatch after a platform
+        # or version change can be benign re-ordering: degrade to a
+        # loud warning, never abort a healthy restore on it.
+        try:
+            from .serialization import str_to_dtype
+
+            itemsize = _np.dtype(str_to_dtype(dtype_by_path[path])).itemsize
+        except Exception:
+            itemsize = 0
+        if itemsize == 4:
+            if path not in mismatched:
+                mismatched.append(path)
+        elif path not in soft_mismatched:
+            soft_mismatched.append(path)
+    if soft_mismatched:
+        logger.warning(
+            f"restore(verify_device=True): fingerprint mismatch on "
+            f"{soft_mismatched} — for these non-4-byte dtypes this can "
+            f"be corruption OR a platform/jax-version word-packing "
+            f"change since the take (see fingerprint.py); verify the "
+            f"snapshot with Snapshot.verify() if in doubt."
+        )
+    if mismatched:
+        raise RuntimeError(
+            f"restore(verify_device=True): restored content does not "
+            f"match the manifest fingerprint for {mismatched} — the "
+            f"bytes in device memory are not the bytes the snapshot "
+            f"recorded (host→device corruption or an assembly bug)."
+        )
+    return verified, skipped
 
 
 def _entry_has_checksum(entry: Entry) -> bool:

@@ -89,16 +89,20 @@ def make_ref_location(base_idx: int, location: str) -> str:
 
 
 def parse_ref_location(path: str) -> Optional[Tuple[int, str]]:
-    """``"@base<N>/<rest>"`` → ``(N, rest)``; None for ordinary paths."""
+    """``"@base<N>/<rest>"`` → ``(N, rest)``; None for ordinary paths.
+    ``N`` must be exactly what :func:`make_ref_location` emits — plain
+    digits. ``int()`` alone would accept "-1"/"+1"/whitespace, and a
+    negative index would wrap through Python list indexing into the
+    WRONG base root instead of tripping the corrupt-metadata guard."""
     if not path.startswith(_REF_MARKER):
         return None
     head, sep, rest = path.partition("/")
     if not sep:
         return None
-    try:
-        return int(head[len(_REF_MARKER):]), rest
-    except ValueError:
+    digits = head[len(_REF_MARKER):]
+    if not digits.isdigit():
         return None
+    return int(digits), rest
 
 
 def is_ref_location(path: str) -> bool:
