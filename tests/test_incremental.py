@@ -672,6 +672,42 @@ def test_restore_verify_device_skips_unfingerprinted(tmp_path, caplog):
                           np.asarray(app["model"]["w"]))
 
 
+def test_incremental_across_compression_change(tmp_path):
+    """A compressed base dedups into an uncompressed take (and back):
+    fingerprints cover the UNCOMPRESSED logical payload, and the ref
+    entry copies the base's compression tag so restore decodes right."""
+    app = {"model": StateDict(w=jnp.arange(4096, dtype=jnp.float32))}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, compression="zlib",
+                       fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)  # no compression
+    m2 = s2.get_manifest()
+    assert m2["0/model/w"].base is not None
+    assert m2["0/model/w"].compression == "zlib"  # describes base's object
+    fresh = {"model": StateDict(w=jnp.zeros(4096, jnp.float32))}
+    s2.restore(fresh, verify_device=True)
+    assert np.array_equal(np.asarray(fresh["model"]["w"]),
+                          np.arange(4096, dtype=np.float32))
+    assert s2.verify() == {}
+    # and a compressed take over an uncompressed-referencing base
+    s3 = Snapshot.take(str(tmp_path / "s3"), app, base=s2,
+                       compression="zlib")
+    assert s3.get_manifest()["0/model/w"].base is not None
+    assert s3.verify() == {}
+
+
+def test_incremental_bfloat16_leaves(tmp_path):
+    app = {"model": StateDict(w=jnp.ones((64, 64), jnp.bfloat16))}
+    s1 = Snapshot.take(str(tmp_path / "s1"), app, fingerprint=True)
+    s2 = Snapshot.take(str(tmp_path / "s2"), app, base=s1)
+    assert s2.get_manifest()["0/model/w"].base is not None
+    fresh = {"model": StateDict(w=jnp.zeros((64, 64), jnp.bfloat16))}
+    s2.restore(fresh, verify_device=True)
+    assert np.array_equal(
+        np.asarray(fresh["model"]["w"]).view(np.uint16),
+        np.asarray(app["model"]["w"]).view(np.uint16),
+    )
+
+
 def test_rng_state_flows_through_incremental(tmp_path):
     from torchsnapshot_tpu import RNGState
 

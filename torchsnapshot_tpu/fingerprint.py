@@ -162,6 +162,43 @@ def fingerprint_device_async(
     return _fingerprint_device_jit(x, static)
 
 
+def resolve_fingerprints(results: list) -> list:
+    """Resolve a batch of :func:`fingerprint_device_async` results with
+    ONE device→host fetch per device: each individual 16-byte fetch
+    pays a full link round trip (~90 ms measured over a congested
+    TPU tunnel — the difference between a 0.9 s and a 0.2 s async-take
+    stall at 10 leaves). Returns a list aligned with ``results`` whose
+    elements are fingerprint strings, or the per-item ``Exception`` on
+    failure (mixed placements fall back to per-item fetches)."""
+    import jax.numpy as jnp
+
+    out: list = [None] * len(results)
+    by_device: dict = {}
+    for i, r in enumerate(results):
+        try:
+            dev = next(iter(r.devices()))
+        except Exception:
+            dev = None
+        by_device.setdefault(dev, []).append(i)
+    for idxs in by_device.values():
+        rows = None
+        if len(idxs) > 1:
+            try:
+                rows = np.asarray(jnp.stack([results[i] for i in idxs]))
+            except Exception:
+                rows = None  # mixed placements etc.: per-item fallback
+        if rows is not None:
+            for i, row in zip(idxs, rows):
+                out[i] = format_fingerprint(row)
+            continue
+        for i in idxs:
+            try:
+                out[i] = format_fingerprint(np.asarray(results[i]))
+            except Exception as e:
+                out[i] = e
+    return out
+
+
 # ------------------------------------------------------------------- host
 
 _HOST_CHUNK_WORDS = 1 << 22  # 16 MiB per pass

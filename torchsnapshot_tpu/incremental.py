@@ -142,7 +142,7 @@ def _compute_fingerprints(
     from .fingerprint import (
         fingerprint_device_async,
         fingerprint_host,
-        format_fingerprint,
+        resolve_fingerprints,
     )
     from .io_preparer import ArrayBufferStager
 
@@ -190,12 +190,13 @@ def _compute_fingerprints(
                 stats.fingerprinted += 1
             except Exception as e:
                 _note_failure(getattr(data, "dtype", type(data)), e)
-    for entry, result in pending:
-        try:
-            fingerprints[id(entry)] = format_fingerprint(np.asarray(result))
+    resolved = resolve_fingerprints([r for _, r in pending])
+    for (entry, _), res in zip(pending, resolved):
+        if isinstance(res, str):
+            fingerprints[id(entry)] = res
             stats.fingerprinted += 1
-        except Exception as e:
-            _note_failure(entry.dtype, e)
+        else:
+            _note_failure(entry.dtype, res)
     return fingerprints
 
 

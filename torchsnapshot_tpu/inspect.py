@@ -112,7 +112,8 @@ def main(argv=None) -> int:
         help="content-diff PATH against the OLDER snapshot: which "
         "logical paths were added/removed/changed/unchanged (exact when "
         "both takes recorded fingerprints); metadata-only, no payload "
-        "reads; exit 1 when anything changed",
+        "reads; exit 1 when anything changed, 2 when the comparison was "
+        "inconclusive for some paths (unknown) with no definite change",
     )
     args = parser.parse_args(argv)
 
@@ -142,11 +143,11 @@ def main(argv=None) -> int:
             f"{len(result['unchanged'])} unchanged, "
             f"{len(result['unknown'])} unknown"
         )
-        return (
-            1
-            if (result["added"] or result["removed"] or result["changed"])
-            else 0
-        )
+        if result["added"] or result["removed"] or result["changed"]:
+            return 1
+        # Inconclusive is NOT "identical": a CI gate must be able to
+        # tell "nothing changed" from "could not compare".
+        return 2 if result["unknown"] else 0
     if args.copy_to:
         Snapshot(args.path).copy_to(args.copy_to)
         print(f"copied {args.path} -> {args.copy_to} (verified in transit)")

@@ -37,6 +37,7 @@ from .coord import Coordinator, barrier_compat, get_coordinator
 from .io_types import IOReq, is_not_found_error
 from .snapshot import (
     _COMPLETION_TIMEOUT_S,
+    _BaseFromRank0,
     BASE_FROM_RANK0,
     PendingSnapshot,
     Snapshot,
@@ -290,16 +291,13 @@ class CheckpointManager:
             # here without waiting for rank 0's broadcast.
             return None
         if coordinator.get_rank() != 0:
-            # Ranks != 0 avoid the storage listing; when they hold the
-            # handle of the step this manager just committed they pass
-            # it — rank 0's collated answer will normally name the same
-            # path and the handle's seeded metadata cache saves this
-            # rank the multi-MB base-metadata GET + parse. If rank 0
-            # resolves differently (stale manager, out-of-order step)
-            # the collation wins and this rank reads from storage.
-            if self._last_saved is not None:
-                return self._last_saved
-            return BASE_FROM_RANK0
+            # Ranks != 0 defer to rank 0's collated answer (no storage
+            # listing, no divergence warning); the retained handle rides
+            # along as a HINT — when rank 0 names the same snapshot,
+            # the handle's seeded metadata cache saves this rank the
+            # multi-MB base-metadata GET + parse, and when it does not
+            # (stale manager, out-of-order step) the hint is ignored.
+            return _BaseFromRank0(hint=self._last_saved)
         latest = self.latest_step()
         if latest is None or latest >= step:
             # No committed base, or out-of-order/re-saved step numbers:

@@ -1416,11 +1416,22 @@ class PendingSnapshot:
 # ------------------------------------------------------------------ helpers
 
 
-# Sentinel ``base`` value for callers that resolve the base on rank 0
-# only (CheckpointManager): other ranks pass this instead of None, which
-# both documents the intent and keeps the divergence warning quiet —
-# deferring to rank 0 IS the protocol, not a bug to warn about.
-BASE_FROM_RANK0 = object()
+class _BaseFromRank0:
+    """``base`` value for callers that resolve the base on rank 0 only
+    (CheckpointManager): ranks != 0 pass this instead of a value of
+    their own, which documents the intent and keeps the divergence
+    warning quiet — deferring to rank 0 IS the protocol, not a bug to
+    warn about. ``hint`` optionally carries the rank's local guess (the
+    handle of the step the manager last committed): if rank 0's
+    collated answer names the same snapshot, the hint's seeded metadata
+    cache saves this rank the base-metadata GET + parse; if rank 0
+    resolved differently, the hint is silently ignored."""
+
+    def __init__(self, hint: Optional["Snapshot"] = None) -> None:
+        self.hint = hint
+
+
+BASE_FROM_RANK0 = _BaseFromRank0()
 
 
 def _resolve_base_arg(base: Optional[Any]) -> Optional[Any]:
@@ -1428,7 +1439,7 @@ def _resolve_base_arg(base: Optional[Any]) -> Optional[Any]:
     Never raises: validation happens AFTER the collation collective, so
     every rank raises (or proceeds) uniformly — a pre-collective raise
     on one rank would strand its peers in the broadcast."""
-    if base is None or base is BASE_FROM_RANK0:
+    if base is None or isinstance(base, _BaseFromRank0):
         return base
     return base.path if isinstance(base, Snapshot) else str(base)
 
@@ -1439,7 +1450,10 @@ def _reusable_base_metadata(
     """A Snapshot handle's cached metadata, reusable for the incremental
     pass iff the handle is the collectively-agreed base — skips one
     metadata GET + parse per take (multi-MB at FSDP scale). The dedup
-    logic tolerates the cache's decorated ("@base…") locations."""
+    logic tolerates the cache's decorated ("@base…") locations.
+    A ``_BaseFromRank0`` hint counts iff it names rank 0's answer."""
+    if isinstance(base, _BaseFromRank0):
+        base = base.hint
     if (
         isinstance(base, Snapshot)
         and collated_base_path is not None
@@ -1459,9 +1473,10 @@ def _collate_incremental_args(
     nicety — entry ``base`` indices resolve against the MERGED
     metadata's base_paths (rank 0's namespace), so a rank deduping
     against a different base would commit references that resolve to
-    the wrong snapshot's bytes. Ranks passing ``BASE_FROM_RANK0``
-    opted into rank 0's answer by protocol — no warning."""
-    deferred = base_path is BASE_FROM_RANK0
+    the wrong snapshot's bytes. Ranks passing ``BASE_FROM_RANK0`` (with
+    or without a hint) opted into rank 0's answer by protocol — no
+    warning."""
+    deferred = isinstance(base_path, _BaseFromRank0)
     local = (None if deferred else base_path, fingerprint)
     collated = coordinator.broadcast_object(local, src=0)
     if not deferred and collated != local:
@@ -2209,7 +2224,11 @@ def _diff_verdict(a: Entry, b: Entry) -> str:
                 return "changed"
         return "unknown"
     if isinstance(a, ShardedArrayEntry):
-        if a.dtype != b.dtype or list(a.shape) != list(b.shape):
+        if (
+            a.dtype != b.dtype
+            or list(a.shape) != list(b.shape)
+            or a.prng_impl != b.prng_impl
+        ):
             return "changed"
         regions_a = {
             (tuple(s.offsets), tuple(s.sizes)): s.array for s in a.shards
@@ -2228,11 +2247,16 @@ def _diff_verdict(a: Entry, b: Entry) -> str:
             return "unknown"
         return "unchanged"
     if isinstance(a, ObjectEntry):
-        if a.checksum and b.checksum and a.compression == b.compression:
-            if a.checksum == b.checksum:
-                return "unchanged"
-            if a.compression is None:
-                return "changed"
+        # Equal pickled bytes prove equality; DIFFERING bytes prove
+        # nothing (pickle is not content-deterministic — dict/set
+        # ordering, PYTHONHASHSEED), so never report "changed".
+        if (
+            a.checksum
+            and b.checksum
+            and a.compression == b.compression
+            and a.checksum == b.checksum
+        ):
+            return "unchanged"
         return "unknown"
     return "unknown"
 
@@ -2260,7 +2284,7 @@ def _verify_restored_fingerprints(
     from .fingerprint import (
         fingerprint_device_async,
         fingerprint_host,
-        format_fingerprint,
+        resolve_fingerprints,
     )
 
     pending: List[Tuple[str, str, Any]] = []
@@ -2322,12 +2346,22 @@ def _verify_restored_fingerprints(
         )
         for path, entry, _ in jobs
     }
-    for path, expected, result in pending:
-        actual = (
-            result
-            if isinstance(result, str)
-            else format_fingerprint(_np.asarray(result))
-        )
+    # Batched resolution (one fetch per device) for the device results;
+    # host results are already strings.
+    device_idxs = [
+        i for i, (_, _, r) in enumerate(pending) if not isinstance(r, str)
+    ]
+    resolved = resolve_fingerprints([pending[i][2] for i in device_idxs])
+    actuals: Dict[int, Any] = dict(zip(device_idxs, resolved))
+    for i, (path, expected, result) in enumerate(pending):
+        actual = result if isinstance(result, str) else actuals[i]
+        if isinstance(actual, Exception):
+            logger.warning(
+                f"verify_device: cannot resolve fingerprint for {path}: "
+                f"{actual!r}; skipping"
+            )
+            skipped += 1
+            continue
         if actual == expected:
             verified += 1
             continue

@@ -100,7 +100,10 @@ def parse_ref_location(path: str) -> Optional[Tuple[int, str]]:
     if not sep:
         return None
     digits = head[len(_REF_MARKER):]
-    if not digits.isdigit():
+    # ASCII digits only: isdigit() alone admits Unicode digit-likes
+    # (e.g. "²") that int() then rejects with an uncaught ValueError —
+    # in exactly the corrupt-input case this parse exists to neutralize.
+    if not (digits.isascii() and digits.isdigit()):
         return None
     return int(digits), rest
 
