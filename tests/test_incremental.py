@@ -622,6 +622,14 @@ def test_inspect_diff_cli(tmp_path, capsys):
     assert rc == 1 and "changed" in out and "model/w" in out
     rc = main([str(tmp_path / "s1"), "--diff", str(tmp_path / "s1")])
     assert rc == 0
+    # inconclusive is exit 3 — distinct from both "identical" (0) and
+    # argparse's usage-error 2: differing compression settings make the
+    # stored checksums incomparable without fingerprints
+    app2 = {"model": StateDict(w=jnp.ones(64, jnp.float32))}
+    Snapshot.take(str(tmp_path / "o1"), app2)
+    Snapshot.take(str(tmp_path / "o2"), app2, compression="zlib")
+    rc = main([str(tmp_path / "o2"), "--diff", str(tmp_path / "o1")])
+    assert rc == 3
 
 
 def test_restore_verify_device_passes_and_catches_corruption(tmp_path):

@@ -112,8 +112,9 @@ def main(argv=None) -> int:
         help="content-diff PATH against the OLDER snapshot: which "
         "logical paths were added/removed/changed/unchanged (exact when "
         "both takes recorded fingerprints); metadata-only, no payload "
-        "reads; exit 1 when anything changed, 2 when the comparison was "
-        "inconclusive for some paths (unknown) with no definite change",
+        "reads; exit 1 when anything changed, 3 when the comparison was "
+        "inconclusive for some paths (unknown) with no definite change "
+        "(2 is argparse's usage-error code)",
     )
     args = parser.parse_args(argv)
 
@@ -146,8 +147,10 @@ def main(argv=None) -> int:
         if result["added"] or result["removed"] or result["changed"]:
             return 1
         # Inconclusive is NOT "identical": a CI gate must be able to
-        # tell "nothing changed" from "could not compare".
-        return 2 if result["unknown"] else 0
+        # tell "nothing changed" from "could not compare". 3, not 2 —
+        # argparse exits 2 on usage errors, and a gate must also be
+        # able to tell "inconclusive" from "bad invocation".
+        return 3 if result["unknown"] else 0
     if args.copy_to:
         Snapshot(args.path).copy_to(args.copy_to)
         print(f"copied {args.path} -> {args.copy_to} (verified in transit)")
