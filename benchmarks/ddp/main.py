@@ -36,10 +36,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 sys.path.insert(0, REPO_ROOT)
 
 
-def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
+def _worker(
+    rank, nprocs, store_path, snap_path, total_bytes, out_queue,
+    incremental_frac=None,
+):
     # snap_path may be any storage URL (fs path, memory://..., gs://...).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
+    import jax.numpy as jnp
 
     from torchsnapshot_tpu import Snapshot
     from torchsnapshot_tpu.coord import FileStore, NoOpCoordinator, StoreCoordinator
@@ -60,8 +64,34 @@ def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
     # excluded from the measured window.
     coord.barrier()
     begin = time.monotonic()
-    Snapshot.take(snap_path, {"model": model}, coord=coord, replicated=["**"])
+    base = Snapshot.take(
+        snap_path,
+        {"model": model},
+        coord=coord,
+        replicated=["**"],
+        fingerprint=bool(incremental_frac is not None),
+    )
     elapsed = time.monotonic() - begin
+
+    inc_elapsed = None
+    if incremental_frac is not None:
+        # A "training step" touches ceil(frac * n_params) params; the
+        # rest dedup against the base — the checkpoint-every-N-steps
+        # cost the reference benchmark cannot express.
+        n_changed = max(1, int(round(incremental_frac * len(model.params))))
+        for name in sorted(model.params)[:n_changed]:
+            model.params[name] = model.params[name] + jnp.float32(1)
+        jax.block_until_ready(list(model.params.values()))
+        coord.barrier()
+        inc_begin = time.monotonic()
+        Snapshot.take(
+            f"{snap_path}-inc",
+            {"model": model},
+            coord=coord,
+            replicated=["**"],
+            base=base,
+        )
+        inc_elapsed = time.monotonic() - inc_begin
 
     # Per-rank bytes actually written — the striping evidence. For
     # memory:// each process has its own private "bucket", so its store
@@ -76,11 +106,17 @@ def _worker(rank, nprocs, store_path, snap_path, total_bytes, out_queue):
         rank_bytes = sum(
             len(v) for k, v in store.items() if not k.startswith(".snapshot")
         )
-    out_queue.put((rank, elapsed, model.total_bytes(), rank_bytes))
+    out_queue.put(
+        (rank, elapsed, model.total_bytes(), rank_bytes, inc_elapsed)
+    )
 
 
 def run(
-    nprocs: int, total_bytes: int, base_dir: str, url: Optional[str] = None
+    nprocs: int,
+    total_bytes: int,
+    base_dir: str,
+    url: Optional[str] = None,
+    incremental_frac: Optional[float] = None,
 ) -> dict:
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -92,7 +128,8 @@ def run(
     )
     procs = [
         ctx.Process(
-            target=_worker, args=(r, nprocs, store, snap, total_bytes, q)
+            target=_worker,
+            args=(r, nprocs, store, snap, total_bytes, q, incremental_frac),
         )
         for r in range(nprocs)
     ]
@@ -104,14 +141,20 @@ def run(
         if p.exitcode != 0:
             raise RuntimeError(f"worker failed with exit code {p.exitcode}")
     results = [q.get(timeout=10) for _ in range(nprocs)]
-    elapsed = next(e for r, e, _, _ in results if r == 0)
+    elapsed = next(e for r, e, _, _, _ in results if r == 0)
     nbytes = results[0][2]
-    per_rank = {r: b for r, _, _, b in results if b is not None}
+    per_rank = {r: b for r, _, _, b, _ in results if b is not None}
     out = {
         "nprocs": nprocs,
         "seconds": round(elapsed, 2),
         "GBps": round(nbytes / 1024**3 / elapsed, 3),
     }
+    inc_times = [i for r, _, _, _, i in results if r == 0 and i is not None]
+    if inc_times:
+        out["incremental_seconds"] = round(inc_times[0], 2)
+        out["incremental_speedup"] = round(
+            elapsed / max(inc_times[0], 1e-9), 2
+        )
     if per_rank:
         out["per_rank_written_MB"] = {
             r: round(b / 1024**2, 1) for r, b in sorted(per_rank.items())
@@ -141,6 +184,15 @@ def main() -> None:
         help="storage URL prefix (e.g. gs://bucket/bench, memory://bench); "
         "default: a directory under --work-dir",
     )
+    parser.add_argument(
+        "--incremental-frac",
+        type=float,
+        default=None,
+        help="also measure an INCREMENTAL take after mutating this "
+        "fraction of params (0.1 = a step that touches 10%% of the "
+        "model); reports the per-run speedup of take(base=prev) over "
+        "the full take",
+    )
     args = parser.parse_args()
 
     base_dir = args.work_dir or tempfile.mkdtemp(prefix="tpusnapshot-ddp-")
@@ -148,7 +200,13 @@ def main() -> None:
     try:
         results = []
         for n in ns:
-            res = run(n, args.total_bytes, base_dir, url=args.url)
+            res = run(
+                n,
+                args.total_bytes,
+                base_dir,
+                url=args.url,
+                incremental_frac=args.incremental_frac,
+            )
             results.append(res)
             print(json.dumps(res), file=sys.stderr)
         speedup = results[-1]["GBps"] / max(results[0]["GBps"], 1e-9)
@@ -168,10 +226,13 @@ def main() -> None:
             from torchsnapshot_tpu import Snapshot
 
             for n in ns:
-                try:
-                    Snapshot(f"{args.url.rstrip('/')}/snap-{n}").delete()
-                except Exception:
-                    pass
+                for suffix in ("", "-inc"):
+                    try:
+                        Snapshot(
+                            f"{args.url.rstrip('/')}/snap-{n}{suffix}"
+                        ).delete(force=True)
+                    except Exception:
+                        pass
         if args.work_dir is None:
             shutil.rmtree(base_dir, ignore_errors=True)
 

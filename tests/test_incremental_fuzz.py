@@ -1,0 +1,157 @@
+"""Randomized incremental-chain scenarios.
+
+Seeded fuzz over chains of incremental takes: random leaf sets (dense /
+numpy / chunked-dense / sharded when the mesh allows), random change
+subsets per step, restores at random points in the chain, verify() on
+every snapshot, and child-first deletion at the end. Complements the
+targeted cases in test_incremental.py the way test_roundtrip_fuzz.py
+complements test_snapshot.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def _random_state(rng: np.random.Generator, spec):
+    out = {}
+    for name, (kind, shape) in spec.items():
+        data = rng.standard_normal(shape).astype(np.float32)
+        if kind == "np":
+            out[name] = data
+        elif kind == "jax":
+            out[name] = jnp.asarray(data)
+        elif kind == "sharded":
+            devices = jax.devices()[:4]
+            mesh = jax.sharding.Mesh(np.array(devices).reshape(4), ("dp",))
+            out[name] = jax.device_put(
+                data,
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("dp")
+                ),
+            )
+        else:
+            raise AssertionError(kind)
+    return out
+
+
+def _mutate(rng: np.random.Generator, state, names):
+    for name in names:
+        v = state[name]
+        host = np.asarray(v).copy()
+        idx = tuple(rng.integers(0, s) for s in host.shape)
+        host[idx] += 1.0
+        if isinstance(v, np.ndarray):
+            state[name] = host
+        elif hasattr(v, "sharding") and hasattr(v.sharding, "mesh"):
+            state[name] = jax.device_put(host, v.sharding)
+        else:
+            state[name] = jnp.asarray(host)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_incremental_chain_fuzz(tmp_path, seed, monkeypatch):
+    import torchsnapshot_tpu.io_preparer as iop
+
+    rng = np.random.default_rng(seed)
+    if rng.random() < 0.5:
+        # Exercise format-level chunking half the time.
+        monkeypatch.setattr(iop, "MAX_CHUNK_SIZE_BYTES", 1 << 11)
+    can_shard = len(jax.devices()) >= 4
+    kinds = ["np", "jax"] + (["sharded"] if can_shard else [])
+    spec = {
+        f"leaf{i}": (
+            rng.choice(kinds),
+            tuple(int(s) for s in rng.integers(1, 9, rng.integers(1, 3)))
+            if rng.random() < 0.5
+            else (int(rng.integers(4, 40)) * (4 if can_shard else 1),),
+        )
+        for i in range(int(rng.integers(3, 7)))
+    }
+    # sharded leaves need a leading dim divisible by 4
+    spec = {
+        n: (k, ((4 * max(1, s[0] // 4),) + s[1:]) if k == "sharded" else s)
+        for n, (k, s) in spec.items()
+    }
+
+    state = _random_state(rng, spec)
+    snapshots = []
+    histories = []  # deep host copies per step for later comparison
+    prev = None
+    unchanged_into_step: set = set()
+    total_refs = 0
+    expected_ref_steps = 0
+    n_steps = int(rng.integers(3, 6))
+    for step in range(n_steps):
+        path = str(tmp_path / f"step{step}")
+        app = {"model": StateDict(**state)}
+        snap = Snapshot.take(
+            path,
+            app,
+            base=prev,
+            fingerprint=True,
+            compression="zlib" if rng.random() < 0.3 else None,
+        )
+        snapshots.append(snap)
+        histories.append({n: np.asarray(v).copy() for n, v in state.items()})
+        assert snap.verify() == {}, f"step {step} verify failed"
+        manifest = snap.get_manifest()
+        step_refs = sum(
+            1
+            for e in manifest.values()
+            for a in (
+                [s.array for s in e.shards] if hasattr(e, "shards") else [e]
+            )
+            if getattr(a, "base", None) is not None
+        )
+        if step > 0 and unchanged_into_step:
+            # every unchanged leaf must have deduplicated something
+            assert step_refs >= len(unchanged_into_step), (
+                step,
+                unchanged_into_step,
+            )
+            expected_ref_steps += 1
+        total_refs += step_refs
+        prev = snap
+        # mutate a random subset (possibly empty) for the next step
+        names = [n for n in spec if rng.random() < 0.5]
+        _mutate(rng, state, names)
+        unchanged_into_step = set(spec) - set(names)
+    if expected_ref_steps:
+        assert total_refs > 0
+
+    # restore a few random steps, bit-exact, with device verification
+    for step in rng.choice(n_steps, size=min(3, n_steps), replace=False):
+        template = {
+            "model": StateDict(
+                **{
+                    n: (
+                        np.zeros_like(histories[step][n])
+                        if isinstance(state[n], np.ndarray)
+                        else jnp.zeros(
+                            histories[step][n].shape, jnp.float32
+                        )
+                    )
+                    for n in spec
+                }
+            )
+        }
+        snapshots[step].restore(template, verify_device=True)
+        for n in spec:
+            np.testing.assert_array_equal(
+                np.asarray(template["model"][n]),
+                histories[step][n],
+                err_msg=f"step {step} leaf {n}",
+            )
+
+    # child-first deletion leaves nothing behind
+    for step in reversed(range(n_steps)):
+        snapshots[step].delete()
+    for root, _, files in os.walk(tmp_path):
+        assert not files, (root, files)
