@@ -1,0 +1,12 @@
+"""Fixture: blocking device syncs inside async functions (SNAP001)."""
+import time
+import jax
+import numpy as np
+
+
+async def stage(x):
+    x.block_until_ready()
+    host = jax.device_get(x)
+    arr = np.asarray(x)
+    time.sleep(0.1)
+    return host, arr

@@ -1,0 +1,9 @@
+"""Fixture: rename publishes un-fsynced file data (SNAP002)."""
+import os
+
+
+def write_marker(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)

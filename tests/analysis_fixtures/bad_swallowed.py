@@ -1,0 +1,24 @@
+"""Fixture: broad handlers that discard failures (SNAP003)."""
+
+
+def retry(op):
+    try:
+        return op()
+    except Exception:
+        return None
+
+
+def cleanup(paths, remove):
+    for p in paths:
+        try:
+            remove(p)
+        except:  # noqa: E722
+            pass
+
+
+def tolerant(op):
+    try:
+        op()
+    except BaseException:
+        return False
+    return True

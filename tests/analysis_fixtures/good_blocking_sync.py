@@ -1,0 +1,16 @@
+"""Fixture: sync helpers may block; async code defers to executors."""
+import asyncio
+import time
+import numpy as np
+
+
+def _stage_sync(x):
+    # Blocking is fine here: sync helpers run inside a thread executor.
+    time.sleep(0.001)
+    return np.asarray(x)
+
+
+async def stage(x, executor):
+    loop = asyncio.get_running_loop()
+    await asyncio.sleep(0)
+    return await loop.run_in_executor(executor, _stage_sync, x)

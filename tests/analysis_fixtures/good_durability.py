@@ -1,0 +1,23 @@
+"""Fixture: fsync before rename makes the publish crash-safe."""
+import os
+
+
+def write_marker(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_marker_bare_fsync(path, payload):
+    # The bare-call spelling of the same durable sequence.
+    from os import fsync
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,44 @@
+"""Fixture: broad handlers that propagate, log, or use the failure."""
+import logging
+import traceback
+
+logger = logging.getLogger(__name__)
+
+
+def retry(op):
+    try:
+        return op()
+    except Exception:
+        logger.warning("op failed", exc_info=True)
+        return None
+
+
+def reraise(op):
+    try:
+        return op()
+    except Exception:
+        raise
+
+
+def classify(op, problems):
+    try:
+        return op()
+    except Exception as e:
+        problems.append(f"failed: {e!r}")
+        return None
+
+
+def capture(op, errors):
+    try:
+        return op()
+    except BaseException:
+        errors.append(traceback.format_exc())
+        return None
+
+
+def narrow(op):
+    # Narrow catches are out of scope for the rule.
+    try:
+        return op()
+    except FileNotFoundError:
+        return None

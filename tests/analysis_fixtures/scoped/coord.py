@@ -1,0 +1,39 @@
+"""Fixture: lock-disciplined mutations pass SNAP005.
+
+Named ``coord.py`` so the rule's default module scoping applies.
+"""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._data = {}
+        self._cond = threading.Condition()
+
+    def set(self, key, value):
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
+    def delete(self, key):
+        with self._cond:
+            self._data.pop(key, None)
+
+    def get(self, key):
+        with self._cond:
+            return self._data.get(key)
+
+    def annotate_only(self):
+        # Bare annotation: declares, not mutates -- must not be flagged.
+        self.hint: int
+        return getattr(self, "hint", None)
+
+
+class Confined:
+    """No lock attribute anywhere: the class is not checked."""
+
+    def __init__(self):
+        self.items = []
+
+    def push(self, x):
+        self.items.append(x)

@@ -1,0 +1,30 @@
+"""Fixture: nondeterminism in a serialization-scoped module (SNAP004).
+
+Named ``fingerprint.py`` so the rule's default module scoping applies.
+"""
+import json
+import random
+import time
+import yaml
+
+
+def fingerprint(payload):
+    salt = time.time()
+    jitter = random.random()
+    tag = hash(str(payload))
+    return salt, jitter, tag
+
+
+def dump_manifest(doc):
+    return json.dumps(doc)
+
+
+def dump_manifest_yaml(doc):
+    return yaml.dump(doc, sort_keys=False)
+
+
+def iter_entries(entries):
+    out = []
+    for e in set(entries):
+        out.append(e)
+    return out

@@ -1,0 +1,16 @@
+"""Fixture: deterministic serialization passes SNAP004.
+
+Named ``manifest.py`` so the rule's default module scoping applies.
+"""
+import json
+
+
+def dump_manifest(doc):
+    return json.dumps(doc, sort_keys=True)
+
+
+def iter_entries(entries):
+    out = []
+    for e in sorted(set(entries)):
+        out.append(e)
+    return out

@@ -1,0 +1,67 @@
+"""Fixture: lockset violations in a concurrency-scoped module (SNAP005).
+
+Named ``scheduler.py`` so the rule's default module scoping applies.
+"""
+import threading
+
+_MODULE_LOCK = threading.Lock()
+_singleton = None
+
+
+class Cell:
+    def __init__(self):
+        self.value = 0
+        self.history = []
+        self._lock = threading.Lock()
+
+    def charge(self, n):
+        self.value -= n
+
+    def record(self, n):
+        self.history.append(n)
+
+    def release(self, n):
+        with self._lock:
+            self.value += n
+
+
+class Tally:
+    """No lock attribute: presumed thread-confined, class scope unchecked."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+    def run(self, executor):
+        def _cb():
+            self.count += 1
+
+        executor.submit(_cb)
+
+    def run_nonlocal(self, loop, executor):
+        total = 0
+
+        def _cb2():
+            nonlocal total
+            total = total + 1
+
+        loop.run_in_executor(executor, _cb2)
+        return total
+
+
+def set_singleton(value):
+    global _singleton
+    _singleton = value
+
+
+def set_singleton_locked(value):
+    global _singleton
+    with _MODULE_LOCK:
+        _singleton = value
+
+
+def bump_singleton():
+    global _singleton
+    _singleton += 1

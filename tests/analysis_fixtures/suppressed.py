@@ -1,0 +1,22 @@
+"""Fixture: inline suppressions silence individual findings."""
+import time
+
+
+async def wait_inline():
+    time.sleep(0.01)  # snapcheck: disable=blocking-sync -- fixture: same-line form
+
+
+async def wait_above():
+    # snapcheck: disable=blocking-sync -- fixture: comment-line form
+    time.sleep(0.01)
+
+
+async def wait_unsuppressed():
+    time.sleep(0.01)
+
+
+def swallow():
+    try:
+        return 1
+    except Exception:  # snapcheck: disable=swallowed-exception -- fixture
+        return None

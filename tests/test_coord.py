@@ -240,6 +240,78 @@ def test_barrier_timeout_override():
     assert time.monotonic() - t0 < 10
 
 
+def test_barrier_timeout_names_stalled_rank():
+    """The barrier's TimeoutError must say WHICH rank never arrived —
+    an opaque store-key timeout sends the operator grepping logs on
+    every host instead of straight to the stalled one."""
+    store = DictStore()
+    c0 = StoreCoordinator(store, 0, 3, timeout_s=60)
+    # Rank 2 pre-arrives at generation 1 (the coordinator's first
+    # barrier); rank 1 never does — the error must blame 1, not 2.
+    store.set("b/1/2", b"1")
+    with pytest.raises(TimeoutError, match=r"rank 1 never arrived"):
+        c0.barrier(timeout_s=0.2)
+
+
+def test_barrier_timeout_is_one_shared_deadline():
+    """The caller's timeout bounds the whole barrier, not each rank's
+    key wait — otherwise the worst-case wait grows to world x timeout."""
+    import time
+
+    store = DictStore()
+    c0 = StoreCoordinator(store, 0, 8, timeout_s=60)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        c0.barrier(timeout_s=0.3)
+    # 8 absent ranks at a fresh 0.3s each would take ~2.4s.
+    assert time.monotonic() - t0 < 1.5
+
+
+def test_all_gather_timeout_is_one_shared_deadline():
+    """timeout_s bounds the whole gather, not each rank's key (nor each
+    chunk part of one rank's payload)."""
+    import time
+
+    store = DictStore()
+    c0 = StoreCoordinator(store, 0, 6, timeout_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        c0.all_gather_object("mine")
+    # 5 absent ranks at a fresh 0.3s each would take ~1.5s.
+    assert time.monotonic() - t0 < 1.2
+
+
+def test_remaining_floors_above_zero_after_deadline():
+    """Past the shared deadline, per-key waits floor at a small positive
+    budget instead of 0: a backend that checks the deadline before the
+    key (JaxStore's blocking get at 0 ms) would otherwise time out even
+    on an already-published key, and the caller would blame a healthy
+    rank."""
+    import time
+
+    c = StoreCoordinator(DictStore(), 0, 1, timeout_s=60)
+    assert c._remaining(time.monotonic() - 100) >= 0.05
+    assert c._remaining(time.monotonic() + 30) == pytest.approx(30, abs=1)
+
+
+def test_all_gather_timeout_names_missing_rank():
+    store = DictStore()
+    c0 = StoreCoordinator(store, 0, 2, timeout_s=0.2)
+    with pytest.raises(
+        TimeoutError, match=r"rank 1 never finished publishing"
+    ):
+        c0.all_gather_object("mine")
+
+
+def test_broadcast_timeout_names_source_rank():
+    store = DictStore()
+    c1 = StoreCoordinator(store, 1, 2, timeout_s=0.2)
+    with pytest.raises(
+        TimeoutError, match=r"source rank 0 never finished publishing"
+    ):
+        c1.broadcast_object("ignored", src=0)
+
+
 def test_barrier_compat_with_legacy_coordinator():
     """Out-of-tree Coordinator implementations written against the
     pre-r4 ABC (barrier(self), no timeout) must keep working at commit

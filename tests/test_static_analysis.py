@@ -1,0 +1,477 @@
+"""Tests for snapcheck (torchsnapshot_tpu.analysis) — and the repo gate.
+
+Two jobs:
+
+1. **Rule tests** — every rule has at least one positive (bad fixture,
+   exact rule code + line numbers asserted) and one negative (good
+   fixture, zero findings), plus suppression and baseline behavior.
+   Fixtures live in ``tests/analysis_fixtures/``; the ones under
+   ``scoped/`` carry the file names (``scheduler.py``, ``fingerprint.py``,
+   …) that module-scoped rules key on.
+
+2. **The gate** — ``test_repo_is_clean`` runs every rule over the whole
+   ``torchsnapshot_tpu`` package and fails tier-1 on any new violation.
+   Deliberate violations must be suppressed in-line with a justification
+   (``# snapcheck: disable=<rule> -- why``), not fixed here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchsnapshot_tpu import analysis
+from torchsnapshot_tpu.analysis import (
+    BlockingSyncRule,
+    DeterminismRule,
+    DurabilityOrderRule,
+    LocksetRule,
+    SwallowedExceptionRule,
+)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(TESTS_DIR, "analysis_fixtures")
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+PACKAGE = os.path.join(REPO_ROOT, "torchsnapshot_tpu")
+
+
+def analyze(fixture, rules=None):
+    path = os.path.join(FIXTURES, fixture)
+    return analysis.run([path], rules or analysis.default_rules())
+
+
+def findings(result):
+    """(code, line) pairs for every violation, sorted."""
+    return sorted((d.code, d.line) for d in result.violations)
+
+
+# ------------------------------------------------------------------- the gate
+
+
+def test_repo_is_clean():
+    result = analysis.run([PACKAGE], analysis.default_rules())
+    formatted = "\n".join(d.format() for d in result.violations)
+    assert result.ok, (
+        f"snapcheck found new violations in torchsnapshot_tpu/ "
+        f"(fix them or suppress with a justification — see "
+        f"docs/ANALYSIS.md):\n{formatted}"
+        + "".join(f"\nunparseable: {p}: {m}" for p, m in result.errors)
+    )
+
+
+def test_fixture_corpus_is_dirty():
+    # The bad fixtures must keep firing; a rule that stops seeing them
+    # has silently stopped protecting the package too.
+    result = analysis.run([FIXTURES], analysis.default_rules())
+    codes = {d.code for d in result.violations}
+    assert codes == {"SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"}
+
+
+# ------------------------------------------------------- SNAP001 blocking-sync
+
+
+def test_blocking_sync_positive():
+    result = analyze("bad_blocking_sync.py", [BlockingSyncRule()])
+    assert findings(result) == [
+        ("SNAP001", 8),  # x.block_until_ready()
+        ("SNAP001", 9),  # jax.device_get(x)
+        ("SNAP001", 10),  # np.asarray(x)
+        ("SNAP001", 11),  # time.sleep(0.1)
+    ]
+
+
+def test_blocking_sync_negative():
+    # Sync helpers may block (they run in executors); async code that
+    # defers through run_in_executor/asyncio.sleep is clean.
+    result = analyze("good_blocking_sync.py", [BlockingSyncRule()])
+    assert findings(result) == []
+
+
+# ---------------------------------------------------- SNAP002 durability-order
+
+
+def test_durability_order_positive():
+    result = analyze("bad_durability.py", [DurabilityOrderRule()])
+    assert findings(result) == [("SNAP002", 9)]  # os.replace, no fsync
+    assert "fsync" in result.violations[0].message
+
+
+def test_durability_order_negative():
+    result = analyze("good_durability.py", [DurabilityOrderRule()])
+    assert findings(result) == []
+
+
+# ------------------------------------------------- SNAP003 swallowed-exception
+
+
+def test_swallowed_exception_positive():
+    result = analyze("bad_swallowed.py", [SwallowedExceptionRule()])
+    assert findings(result) == [
+        ("SNAP003", 7),  # except Exception: return None
+        ("SNAP003", 15),  # bare except: pass
+        ("SNAP003", 22),  # except BaseException: return False
+    ]
+
+
+def test_swallowed_exception_negative():
+    # Logging, re-raising, using the bound value, and capturing via
+    # traceback all count as handling; narrow catches are out of scope.
+    result = analyze("good_swallowed.py", [SwallowedExceptionRule()])
+    assert findings(result) == []
+
+
+# ------------------------------------------------------ SNAP004 nondeterminism
+
+
+def test_determinism_positive():
+    result = analyze(
+        os.path.join("scoped", "fingerprint.py"), [DeterminismRule()]
+    )
+    assert findings(result) == [
+        ("SNAP004", 12),  # time.time()
+        ("SNAP004", 13),  # random.random()
+        ("SNAP004", 14),  # hash(...)
+        ("SNAP004", 19),  # json.dumps without sort_keys
+        ("SNAP004", 23),  # yaml.dump(..., sort_keys=False)
+        ("SNAP004", 28),  # for e in set(entries)
+    ]
+
+
+def test_determinism_negative():
+    result = analyze(
+        os.path.join("scoped", "manifest.py"), [DeterminismRule()]
+    )
+    assert findings(result) == []
+
+
+def test_determinism_is_module_scoped():
+    # The identical nondeterministic code outside a serialization module
+    # is not this rule's business.
+    rule = DeterminismRule()
+    assert not rule.applies_to("torchsnapshot_tpu/scheduler.py")
+    result = analyze("bad_blocking_sync.py", [rule])
+    assert findings(result) == []
+
+
+# ------------------------------------------------------------ SNAP005 lockset
+
+
+def test_lockset_positive():
+    result = analyze(
+        os.path.join("scoped", "scheduler.py"), [LocksetRule()]
+    )
+    assert findings(result) == [
+        ("SNAP005", 18),  # Cell.charge: self.value -= n, no lock
+        ("SNAP005", 21),  # Cell.record: self.history.append, no lock
+        ("SNAP005", 39),  # executor callback mutates self.count
+        ("SNAP005", 48),  # executor callback assigns nonlocal total
+        ("SNAP005", 56),  # global _singleton assigned without module lock
+        ("SNAP005", 67),  # global _singleton augmented without module lock
+    ]
+
+
+def test_lockset_negative():
+    # with-lock mutations pass; a class with no lock attribute is
+    # presumed thread-confined and unchecked.
+    result = analyze(os.path.join("scoped", "coord.py"), [LocksetRule()])
+    assert findings(result) == []
+
+
+def test_lockset_callback_reported_once():
+    # A callback nested under several functions is reachable from every
+    # enclosing function's walk; the violation must not be duplicated.
+    source = (
+        "class C:\n"
+        "    def outer(self, executor):\n"
+        "        def mid():\n"
+        "            def cb():\n"
+        "                self.count += 1\n"
+        "            executor.submit(cb)\n"
+        "        mid()\n"
+    )
+    result = analysis.analyze_source(
+        source, "scheduler.py", [LocksetRule()]
+    )
+    assert [(d.code, d.line) for d in result.diagnostics] == [("SNAP005", 5)]
+
+
+def test_lockset_is_module_scoped():
+    rule = LocksetRule()
+    assert rule.applies_to("torchsnapshot_tpu/coord.py")
+    assert not rule.applies_to("torchsnapshot_tpu/snapshot.py")
+
+
+# -------------------------------------------------------------- suppressions
+
+
+def test_inline_suppressions():
+    result = analyze("suppressed.py")
+    # Same-line and comment-line-above forms both silence their finding;
+    # the unsuppressed sleep still fires.
+    assert findings(result) == [("SNAP001", 15)]
+    silenced = sorted((d.code, d.line) for d in result.suppressed)
+    assert silenced == [
+        ("SNAP001", 6),
+        ("SNAP001", 11),
+        ("SNAP003", 21),
+    ]
+
+
+def test_suppression_by_rule_code():
+    # Diagnostics print the SNAPxxx code first, so a developer copying
+    # it from a CI failure into a directive must get a working
+    # suppression.
+    source = (
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:  # snapcheck: disable=SNAP003 -- probe\n"
+        "        return None\n"
+    )
+    result = analysis.analyze_source(
+        source, "x.py", [SwallowedExceptionRule()]
+    )
+    assert result.diagnostics == []
+    assert [d.code for d in result.suppressed] == ["SNAP003"]
+
+
+def test_suppression_comma_list_tolerates_spaces():
+    # "disable=a, b" — a space after the comma must not silently drop
+    # the rules that follow it.
+    source = (
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:  "
+        "# snapcheck: disable=nondeterminism, swallowed-exception -- why\n"
+        "        return None\n"
+    )
+    result = analysis.analyze_source(
+        source, "x.py", [SwallowedExceptionRule()]
+    )
+    assert result.diagnostics == []
+    assert [d.code for d in result.suppressed] == ["SNAP003"]
+
+
+def test_suppression_justification_glued_to_rules():
+    # A justification with no space before the "--" must still cut the
+    # rule list there, not become part of a (nonexistent) rule name.
+    source = (
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:  # snapcheck: disable=swallowed-exception--probe\n"
+        "        return None\n"
+    )
+    result = analysis.analyze_source(
+        source, "x.py", [SwallowedExceptionRule()]
+    )
+    assert result.diagnostics == []
+    assert [d.code for d in result.suppressed] == ["SNAP003"]
+
+
+def test_suppression_inside_string_literal_is_ignored():
+    # A directive quoted in a docstring (e.g. documentation of the
+    # suppression syntax) must not silence anything — only real
+    # comments count.
+    source = (
+        '"""Docs: write # snapcheck: disable-file=swallowed-exception\n'
+        'to silence the rule file-wide."""\n'
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    result = analysis.analyze_source(
+        source, "x.py", [SwallowedExceptionRule()]
+    )
+    assert [(d.code, d.line) for d in result.diagnostics] == [("SNAP003", 6)]
+    assert result.suppressed == []
+
+
+def test_filewide_suppression_is_per_rule():
+    result = analyze("suppressed_filewide.py")
+    # disable-file silences every swallowed-exception in the file but
+    # leaves other rules armed.
+    assert findings(result) == [("SNAP001", 21)]
+    assert {d.code for d in result.suppressed} == {"SNAP003"}
+
+
+# ------------------------------------------------------------------- baseline
+
+
+def test_baseline_masks_preexisting_findings(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_swallowed.py")
+    rules = [SwallowedExceptionRule()]
+    first = analysis.run([bad], rules)
+    assert len(first.violations) == 3
+
+    baseline_path = tmp_path / "baseline.json"
+    analysis.save_baseline(str(baseline_path), first.fingerprints)
+    baseline = analysis.load_baseline(str(baseline_path))
+
+    masked = analysis.run([bad], rules, baseline=baseline)
+    assert masked.ok
+    assert len(masked.baselined) == 3
+    assert masked.violations == []
+
+
+def test_baseline_does_not_mask_new_findings(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_swallowed.py")
+    rules = [SwallowedExceptionRule()]
+    first = analysis.run([bad], rules)
+    baseline_path = tmp_path / "baseline.json"
+    # Baseline only the first finding: the other two stay violations.
+    analysis.save_baseline(str(baseline_path), first.fingerprints[:1])
+    baseline = analysis.load_baseline(str(baseline_path))
+    partial = analysis.run([bad], rules, baseline=baseline)
+    assert len(partial.baselined) == 1
+    assert len(partial.violations) == 2
+    assert not partial.ok
+
+
+def test_baseline_matches_across_path_spellings(tmp_path):
+    # A baseline written via `pkg/file.py` must keep matching when the
+    # gate is later invoked as `./pkg/file.py` or an absolute path —
+    # otherwise every baselined finding reappears on a CI that spells
+    # the target differently than the bootstrap did.
+    baseline = str(tmp_path / "baseline.json")
+    rel = os.path.relpath(
+        os.path.join(FIXTURES, "bad_swallowed.py"), REPO_ROOT
+    )
+    wrote = run_cli("--write-baseline", baseline, rel)
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    for spelling in (os.path.join(".", rel), os.path.join(REPO_ROOT, rel)):
+        gated = run_cli("--baseline", baseline, spelling)
+        assert gated.returncode == 0, (
+            f"{spelling}: {gated.stdout}{gated.stderr}"
+        )
+
+
+def test_baseline_fingerprint_survives_line_drift():
+    source_v1 = (
+        "def f(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    # Same flagged code, shifted down by a new leading comment.
+    source_v2 = "# a new header comment\n\n" + source_v1
+    rules = [SwallowedExceptionRule()]
+    r1 = analysis.analyze_source(source_v1, "x.py", rules)
+    r2 = analysis.analyze_source(source_v2, "x.py", rules)
+    assert r1.diagnostics[0].line != r2.diagnostics[0].line
+    assert r1.fingerprints[0] == r2.fingerprints[0]
+
+
+# --------------------------------------------------------------- rule registry
+
+
+def test_select_rules():
+    assert len(analysis.select_rules(None)) == 5
+    by_name = analysis.select_rules(["blocking-sync", "lockset"])
+    assert sorted(r.code for r in by_name) == ["SNAP001", "SNAP005"]
+    by_code = analysis.select_rules(["SNAP002"])
+    assert [r.name for r in by_code] == ["durability-order"]
+    with pytest.raises(ValueError, match="Unknown rule"):
+        analysis.select_rules(["no-such-rule"])
+
+
+def test_rule_codes_are_unique_and_stable():
+    rules = analysis.default_rules()
+    codes = [r.code for r in rules]
+    assert len(set(codes)) == len(codes)
+    assert all(c.startswith("SNAP") for c in codes)
+    assert all(r.name and r.description for r in rules)
+
+
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    result = analysis.analyze_source("def broken(:\n", "broken.py", [])
+    assert result.error is not None and "syntax error" in result.error
+    # An unparseable file fails the gate: it cannot be proven clean.
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n")
+    run_result = analysis.run([str(broken)], analysis.default_rules())
+    assert not run_result.ok
+    assert run_result.errors and run_result.errors[0][0] == str(broken)
+
+
+def test_unreadable_file_is_reported_not_raised(tmp_path):
+    # A non-UTF8 file must fail the gate as a reported error, not crash
+    # the whole run with a raw UnicodeDecodeError.
+    binary = tmp_path / "binary.py"
+    binary.write_bytes(b"\xff\xfe\x00junk")
+    result = analysis.run([str(binary)], analysis.default_rules())
+    assert not result.ok
+    assert result.errors and "unreadable" in result.errors[0][1]
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def run_cli(*args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env=env,
+        timeout=120,
+    )
+
+
+def test_cli_clean_on_package():
+    proc = run_cli(PACKAGE)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stdout
+
+
+def test_cli_dirty_on_fixture_corpus_json():
+    proc = run_cli("--format", "json", FIXTURES)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    codes = {v["code"] for v in doc["violations"]}
+    assert codes == {"SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"}
+    sample = doc["violations"][0]
+    # Machine-readable contract: rule id, stable code, location, message.
+    assert set(sample) >= {"rule", "code", "path", "line", "col", "message"}
+
+
+def test_cli_baseline_roundtrip(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_durability.py")
+    baseline = str(tmp_path / "baseline.json")
+    wrote = run_cli("--write-baseline", baseline, bad)
+    assert wrote.returncode == 0
+    gated = run_cli("--baseline", baseline, bad)
+    assert gated.returncode == 0
+    assert "1 baselined" in gated.stdout
+
+
+def test_cli_rule_filter_and_usage_errors():
+    only_async = run_cli("--rules", "blocking-sync", FIXTURES)
+    assert only_async.returncode == 1
+    assert "SNAP001" in only_async.stdout
+    assert "SNAP003" not in only_async.stdout
+    bad_rule = run_cli("--rules", "no-such-rule", FIXTURES)
+    assert bad_rule.returncode == 2
+    # A nonexistent directory is a usage error; a nonexistent .py file
+    # is reported like any unreadable file and fails the gate.
+    missing_dir = run_cli("/no/such/dir")
+    assert missing_dir.returncode == 2
+    missing_file = run_cli("/no/such/path.py")
+    assert missing_file.returncode == 1
+    assert "unreadable" in missing_file.stdout
+
+
+def test_cli_list_rules():
+    proc = run_cli("--list-rules")
+    assert proc.returncode == 0
+    for code in ("SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"):
+        assert code in proc.stdout

@@ -61,6 +61,60 @@ def test_fs_no_partial_write_visible(tmp_path):
     assert leftovers == []
 
 
+def test_fs_dir_fsyncs_batch_to_publish_point(tmp_path, monkeypatch):
+    # Data-object writes defer their directory fsync; the next publish
+    # point (dot-prefixed metadata/marker write) pays one fsync per
+    # dirty directory, covering every object renamed into it since.
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    synced = []
+    monkeypatch.setattr(fs_mod, "_fsync_dir", synced.append)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    for i in range(3):
+        asyncio.run(plugin.write(IOReq(path=f"shard/obj{i}", data=b"x")))
+    # Only the one dir-creation fsync (shard's parent, via _prepare_dir);
+    # the three object dirents are deferred — nothing references them yet.
+    assert synced == [str(tmp_path)]
+    assert plugin._dirty_dirs == {str(tmp_path / "shard")}
+
+    asyncio.run(plugin.write(IOReq(path=".snapshot_metadata", data=b"m")))
+    # One batched fsync for the dirty data dir, then one for the dir the
+    # metadata itself landed in — in that order.
+    assert synced[1:] == [str(tmp_path / "shard"), str(tmp_path)]
+    assert plugin._dirty_dirs == set()
+
+    # ensure_durable() — the commit-protocol hook for ranks whose route
+    # writes no marker of their own — drains the batch too, including
+    # through the retry decorator url_to_storage_plugin wraps with.
+    wrapped = url_to_storage_plugin(str(tmp_path))
+    wrapped._inner._dirty_dirs.add(str(tmp_path / "shard"))
+    wrapped.ensure_durable()
+    assert synced[-1] == str(tmp_path / "shard")
+    assert wrapped._inner._dirty_dirs == set()
+
+    # close() drains anything a publish never covered.
+    asyncio.run(plugin.write(IOReq(path="shard/late", data=b"x")))
+    plugin.close()
+    assert synced[-1] == str(tmp_path / "shard")
+
+
+def test_fs_fsyncs_created_root_ancestors(tmp_path, monkeypatch):
+    # A root that does not exist yet (step dirs under a fresh job dir):
+    # makedirs conjures the whole chain, and every created directory's
+    # dirent — including the root's own, above the plugin root — must be
+    # fsynced, or a crash can drop the entire snapshot directory.
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    synced = []
+    monkeypatch.setattr(fs_mod, "_fsync_dir", synced.append)
+    root = tmp_path / "job" / "step-1"
+    plugin = FSStoragePlugin(root=str(root))
+    asyncio.run(plugin.write(IOReq(path="shard/obj", data=b"x")))
+    # job, step-1, and shard were created: each one's parent is fsynced,
+    # top-downward.
+    assert synced == [str(tmp_path), str(tmp_path / "job"), str(root)]
+
+
 def test_memory_plugin():
     plugin = MemoryStoragePlugin()
     payload = os.urandom(64)
@@ -94,6 +148,27 @@ def test_url_dispatch(tmp_path):
         assert isinstance(plugin._inner, backend_cls)
     with pytest.raises(RuntimeError, match="Unsupported protocol"):
         url_to_storage_plugin("bogus://x")
+
+
+def test_installed_plugin_load_error_propagates(monkeypatch):
+    # A matched entry point whose load() raises must surface the real
+    # error (e.g. a missing optional dep), not "Unsupported protocol" —
+    # the plugin IS installed, and the user should be told what broke.
+    from torchsnapshot_tpu import storage_plugin as sp_mod
+
+    class BrokenEP:
+        name = "myplug"
+
+        def load(self):
+            raise ImportError("myplug needs google-cloud-storage")
+
+    class EPs:
+        def select(self, group):
+            return [BrokenEP()] if group == "storage_plugins" else []
+
+    monkeypatch.setattr(sp_mod.importlib_metadata, "entry_points", EPs)
+    with pytest.raises(ImportError, match="google-cloud-storage"):
+        url_to_storage_plugin("myplug://bucket")
 
 
 def test_memory_object_age_visible_across_instances():

@@ -1,0 +1,103 @@
+"""snapcheck: checkpoint-safety static analysis for torchsnapshot_tpu.
+
+An AST-based, pluggable lint framework encoding this framework's own
+safety invariants as CI-gated rules (see ``docs/ANALYSIS.md``):
+
+==========  =====================  ==========================================
+Code        Rule                   Invariant
+==========  =====================  ==========================================
+SNAP001     blocking-sync          async pipeline never blocks the device /
+                                   event loop
+SNAP002     durability-order       data durable before publication (fsync
+                                   before rename)
+SNAP003     swallowed-exception    retry/commit paths never discard failures
+SNAP004     nondeterminism         fingerprint/manifest serialization is
+                                   reproducible
+SNAP005     lockset                lock-owning state mutated under its lock
+==========  =====================  ==========================================
+
+Run it::
+
+    python -m torchsnapshot_tpu.analysis torchsnapshot_tpu/
+    python -m torchsnapshot_tpu.analysis --format json --baseline b.json src/
+
+Suppress a deliberate violation with a justification::
+
+    except Exception:  # snapcheck: disable=swallowed-exception -- probe
+
+The analyzer itself is pure stdlib — no device, network, or accelerator
+stack is touched at analysis time. (Importing this subpackage does import
+the parent ``torchsnapshot_tpu`` package, so the host still needs the
+repo's dependencies installed — true of the CI job and the pytest gate.)
+"""
+
+from typing import List, Optional, Sequence
+
+from .core import (
+    Diagnostic,
+    FileResult,
+    Rule,
+    RunResult,
+    analyze_file,
+    analyze_source,
+    fingerprint,
+    iter_python_files,
+    load_baseline,
+    run,
+    save_baseline,
+)
+from .rules_async import BlockingSyncRule
+from .rules_determinism import DeterminismRule
+from .rules_durability import DurabilityOrderRule
+from .rules_exceptions import SwallowedExceptionRule
+from .rules_lockset import LocksetRule
+
+
+def default_rules() -> List[Rule]:
+    """Fresh instances of every registered rule."""
+    return [
+        BlockingSyncRule(),
+        DurabilityOrderRule(),
+        SwallowedExceptionRule(),
+        DeterminismRule(),
+        LocksetRule(),
+    ]
+
+
+def select_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Rules filtered by name or code; None = all."""
+    rules = default_rules()
+    if names is None:
+        return rules
+    wanted = {n.strip() for n in names if n.strip()}
+    chosen = [r for r in rules if r.name in wanted or r.code in wanted]
+    known = {r.name for r in rules} | {r.code for r in rules}
+    unknown = wanted - known
+    if unknown:
+        raise ValueError(
+            f"Unknown rule(s): {sorted(unknown)}; "
+            f"known: {sorted(r.name for r in rules)}"
+        )
+    return chosen
+
+
+__all__ = [
+    "BlockingSyncRule",
+    "DeterminismRule",
+    "Diagnostic",
+    "DurabilityOrderRule",
+    "FileResult",
+    "LocksetRule",
+    "Rule",
+    "RunResult",
+    "SwallowedExceptionRule",
+    "analyze_file",
+    "analyze_source",
+    "default_rules",
+    "fingerprint",
+    "iter_python_files",
+    "load_baseline",
+    "run",
+    "save_baseline",
+    "select_rules",
+]

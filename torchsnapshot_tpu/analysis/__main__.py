@@ -1,0 +1,141 @@
+"""CLI: ``python -m torchsnapshot_tpu.analysis [paths...]``.
+
+Exit status: 0 = clean (no violations beyond suppressions/baseline),
+1 = violations or unparseable files, 2 = usage error.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import default_rules, select_rules
+from .core import load_baseline, run, save_baseline
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchsnapshot_tpu.analysis",
+        description=(
+            "snapcheck: checkpoint-safety static analyzer for "
+            "torchsnapshot_tpu (see docs/ANALYSIS.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["torchsnapshot_tpu/"],
+        help="Files or directories to analyze (default: torchsnapshot_tpu/)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="Diagnostic output format",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="Comma-separated rule names/codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help=(
+            "JSON baseline of pre-existing findings; findings in it are "
+            "reported as 'baselined' and do not fail the run"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        default=None,
+        metavar="FILE",
+        help=(
+            "Write every current finding's fingerprint to FILE — "
+            "bootstraps --baseline for a codebase with pre-existing "
+            "findings. Exits 0 unless a file failed to parse (an "
+            "unparseable file cannot be baselined)"
+        ),
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="Print the rule registry and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.code}  {rule.name}\n    {rule.description}")
+        return 0
+
+    try:
+        rules = select_rules(
+            args.rules.split(",") if args.rules else None
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline = None
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        result = run(args.paths, rules, baseline=baseline)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline is not None:
+        save_baseline(args.write_baseline, result.fingerprints)
+        # Unanalyzable files cannot be baselined (errors always fail a
+        # gated run), so a bootstrap over them must say so loudly.
+        for path, message in result.errors:
+            print(
+                f"{path}:1:0: SNAP000 [parse-error] {message} "
+                f"(NOT baselined)",
+                file=sys.stderr,
+            )
+        print(
+            f"snapcheck: wrote {len(result.fingerprints)} finding(s) to "
+            f"baseline {args.write_baseline}"
+        )
+        return 1 if result.errors else 0
+
+    if args.format == "json":
+        doc = {
+            "version": 1,
+            "violations": [d.to_dict() for d in result.violations],
+            "baselined": [d.to_dict() for d in result.baselined],
+            "suppressed": len(result.suppressed),
+            "errors": [
+                {"path": p, "message": m} for p, m in result.errors
+            ],
+            "ok": result.ok,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for diag in result.violations:
+            print(diag.format())
+        for path, message in result.errors:
+            print(f"{path}:1:0: SNAP000 [parse-error] {message}")
+        summary = (
+            f"snapcheck: {len(result.violations)} violation(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed"
+        )
+        if result.errors:
+            summary += f", {len(result.errors)} unparseable file(s)"
+        print(summary)
+
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

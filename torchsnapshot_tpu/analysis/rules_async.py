@@ -1,0 +1,129 @@
+"""SNAP001 ``blocking-sync``: no blocking device synchronization in async code.
+
+The write pipeline's whole point is that the training step resumes while
+staging and storage IO drain in the background (``Snapshot.async_take``,
+``scheduler.execute_write_reqs``). A blocking device sync executed on the
+event-loop thread — ``x.block_until_ready()``, ``jax.device_get(x)``,
+``np.asarray(device_array)`` — stalls *every* in-flight request behind one
+transfer and, during an async take, stalls the training step itself.
+
+The static approximation: inside the body of an ``async def``, any call to
+a known blocking-sync API is flagged. Synchronous helpers are exempt even
+when defined inside an async function — the codebase's convention is that
+sync helpers run inside a thread executor (``loop.run_in_executor``),
+where blocking is exactly what is supposed to happen. ``time.sleep`` in
+async code is flagged for the same reason (use ``asyncio.sleep``).
+
+numpy/jax module aliases are resolved from the file's import statements,
+so ``import numpy as _np; _np.asarray(...)`` is still caught.
+"""
+
+import ast
+from typing import List, Sequence
+
+from .core import Diagnostic, Rule, dotted_name, import_aliases, imported_names
+
+# Attribute method names that synchronize with the device regardless of
+# the receiver's spelling.
+_BLOCKING_METHODS = {"block_until_ready"}
+
+
+class BlockingSyncRule(Rule):
+    name = "blocking-sync"
+    code = "SNAP001"
+    description = (
+        "Blocking device synchronization (block_until_ready, "
+        "jax.device_get, np.asarray, time.sleep) inside an async "
+        "function stalls the event loop and every in-flight request."
+    )
+
+    def check(
+        self, tree: ast.AST, lines: Sequence[str], path: str
+    ) -> List[Diagnostic]:
+        numpy_aliases = import_aliases(tree, "numpy")
+        jax_aliases = import_aliases(tree, "jax")
+        time_aliases = import_aliases(tree, "time")
+        # from jax import device_get / from time import sleep
+        bare_device_get = {
+            n for n in imported_names(tree, "jax") if n == "device_get"
+        }
+        bare_sleep = {n for n in imported_names(tree, "time") if n == "sleep"}
+
+        diags: List[Diagnostic] = []
+        rule = self
+
+        class Visitor(ast.NodeVisitor):
+            def __init__(self) -> None:
+                # Innermost function kind: True = async, False = sync.
+                self._stack: List[bool] = []
+
+            def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+                self._stack.append(True)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            def visit_FunctionDef(self, node: ast.FunctionDef):
+                self._stack.append(False)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            def visit_Lambda(self, node: ast.Lambda):
+                self._stack.append(False)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            def _in_async(self) -> bool:
+                return bool(self._stack) and self._stack[-1]
+
+            def visit_Call(self, node: ast.Call):
+                if self._in_async():
+                    msg = self._classify(node)
+                    if msg is not None:
+                        diags.append(rule.diag(path, node, msg))
+                self.generic_visit(node)
+
+            def _classify(self, node: ast.Call) -> str:
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _BLOCKING_METHODS
+                ):
+                    return (
+                        f"'{func.attr}()' blocks the event loop on a "
+                        f"device transfer; run it in a thread executor "
+                        f"(loop.run_in_executor)."
+                    )
+                name = dotted_name(func)
+                if name is None:
+                    return None
+                root, _, rest = name.partition(".")
+                if root in jax_aliases and rest == "device_get":
+                    return (
+                        "'jax.device_get()' blocks the event loop on a "
+                        "device→host transfer; stage through a "
+                        "BufferStager in a thread executor instead."
+                    )
+                if name in bare_device_get:
+                    return (
+                        "'device_get()' blocks the event loop on a "
+                        "device→host transfer; stage through a "
+                        "BufferStager in a thread executor instead."
+                    )
+                if root in numpy_aliases and rest in ("asarray", "array"):
+                    return (
+                        f"'{name}()' forces a synchronous device→host "
+                        f"copy when handed a jax.Array, stalling the "
+                        f"event loop; move it into a sync helper run via "
+                        f"loop.run_in_executor."
+                    )
+                if (root in time_aliases and rest == "sleep") or (
+                    name in bare_sleep
+                ):
+                    return (
+                        "'time.sleep()' blocks the event loop; use "
+                        "'await asyncio.sleep()'."
+                    )
+                return None
+
+        Visitor().visit(tree)
+        return diags

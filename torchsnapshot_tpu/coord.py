@@ -27,12 +27,15 @@ coordination-service values have size limits (SURVEY §7 hard part #3).
 
 import abc
 import base64
+import logging
 import os
 import pickle
 import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _DEFAULT_TIMEOUT_S = 300.0
 _CHUNK = 512 * 1024  # chunk size for large values through the KV store
@@ -71,7 +74,9 @@ class Store(abc.ABC):
         correctness. Default: poll :meth:`get` with a tiny timeout."""
         try:
             return self.get(key, timeout_s=0.05)
-        except Exception:
+        # A short-poll miss IS the expected "absent now" answer, and this
+        # probe runs per pending ack — logging would flood steady state.
+        except Exception:  # snapcheck: disable=swallowed-exception -- absent-now probe
             return None
 
 
@@ -127,6 +132,12 @@ class FileStore(Store):
         fd, tmp = tempfile.mkstemp(dir=self.path)
         with os.fdopen(fd, "wb") as f:
             f.write(value)
+        # No fsync: coordination keys are ephemeral per-generation values.
+        # close() above precedes the rename, so live readers — including
+        # NFS close-to-open peers — always see full data, and a host
+        # crash kills the whole generation; durability buys nothing and
+        # would cost an fsync per 512KB chunk on the collective hot path.
+        # snapcheck: disable=durability-order -- ephemeral coordination keys
         os.replace(tmp, target)
 
     def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
@@ -192,7 +203,24 @@ class JaxStore(Store):
         )
 
     def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
-        val = self._client.blocking_key_value_get(key, int(timeout_s * 1000))
+        try:
+            val = self._client.blocking_key_value_get(
+                key, int(timeout_s * 1000)
+            )
+        except Exception as e:
+            # The coordination service surfaces expiry as a backend
+            # RuntimeError (DEADLINE_EXCEEDED), not TimeoutError.
+            # Normalize so the collectives' rank-naming timeout handling
+            # works identically on every Store backend.
+            # Match only the structured status token — a broader match
+            # (any message mentioning "deadline") would rewrite
+            # connection/retry errors into TimeoutError and make the
+            # collectives blame a healthy peer rank.
+            if "DEADLINE_EXCEEDED" in str(e):
+                raise TimeoutError(
+                    f"Timed out waiting for key: {key}"
+                ) from e
+            raise
         return base64.b64decode(val.encode("ascii"), validate=True)
 
     def delete(self, key: str) -> None:
@@ -200,8 +228,12 @@ class JaxStore(Store):
             self._client.key_value_delete(key)
         except Exception:
             # Best-effort: a delete that races service restart or an older
-            # jaxlib without key_value_delete must never fail a snapshot.
-            pass
+            # jaxlib without key_value_delete must never fail a snapshot —
+            # but the failure is still visible at debug level so a GC that
+            # silently stops collecting is diagnosable.
+            logger.debug(
+                f"coordination-service delete of {key} failed", exc_info=True
+            )
 
     def try_get(self, key: str) -> Optional[bytes]:
         try:
@@ -209,7 +241,17 @@ class JaxStore(Store):
         except AttributeError:
             # Older jaxlib: fall back to the short blocking poll.
             return super().try_get(key)
-        except Exception:
+        except Exception as e:
+            # Non-blocking probe: absence and transient failure both mean
+            # "not observable now"; GC just defers (see Store.try_get).
+            # Absence (NOT_FOUND) is the steady-state answer for pending
+            # broadcast acks — logging it would flood DEBUG output — so
+            # only genuinely unexpected failures leave a trace.
+            if "NOT_FOUND" not in str(e):
+                logger.debug(
+                    f"coordination-service try_get of {key} failed",
+                    exc_info=True,
+                )
             return None
         return base64.b64decode(val.encode("ascii"), validate=True)
 
@@ -340,13 +382,27 @@ class StoreCoordinator(Coordinator):
             self._store.set(key, b"\x01" + str(n).encode())
             self._own_keys.append((gen, key))
 
-    def _get_chunked(self, key: str) -> bytes:
-        head = self._store.get(key, self._timeout_s)
+    def _remaining(self, deadline: Optional[float]) -> float:
+        if deadline is None:
+            return self._timeout_s
+        # Floor, don't clamp to zero: a zero budget would make backends
+        # that check the deadline before the key (JaxStore's
+        # blocking_key_value_get at 0 ms) time out even on a key that is
+        # already published — and the caller would then blame a healthy
+        # rank. The floor keeps "present key always wins" and bounds the
+        # deadline overshoot at ~50 ms per remaining key.
+        return max(0.05, deadline - time.monotonic())
+
+    def _get_chunked(
+        self, key: str, deadline: Optional[float] = None
+    ) -> bytes:
+        head = self._store.get(key, self._remaining(deadline))
         if head[:1] == b"\x00":
             return head[1:]
         n = int(head[1:].decode())
         return b"".join(
-            self._store.get(f"{key}/part{i}", self._timeout_s) for i in range(n)
+            self._store.get(f"{key}/part{i}", self._remaining(deadline))
+            for i in range(n)
         )
 
     def barrier(self, timeout_s: Optional[float] = None) -> None:
@@ -355,8 +411,22 @@ class StoreCoordinator(Coordinator):
         key = f"b/{gen}/{self._rank}"
         self._store.set(key, b"1")
         self._own_keys.append((gen, key))
+        # One shared deadline for the whole barrier, not a fresh timeout
+        # per rank: the caller's timeout bounds the OPERATION (a per-rank
+        # budget would let the total wait grow to world x timeout), and a
+        # rank that never arrives is named in the error instead of
+        # surfacing as an opaque store-key timeout.
+        deadline = time.monotonic() + wait
         for r in range(self._world):
-            self._store.get(f"b/{gen}/{r}", wait)
+            try:
+                self._store.get(f"b/{gen}/{r}", self._remaining(deadline))
+            except TimeoutError:
+                raise TimeoutError(
+                    f"barrier (generation {gen}) timed out after "
+                    f"{wait:g}s: rank {r} never arrived (observed by "
+                    f"rank {self._rank} of {self._world}). That rank "
+                    f"has likely crashed or is stuck in storage IO."
+                ) from None
         self._gc_through(gen)
 
     def all_gather_object(self, obj: Any) -> List[Any]:
@@ -364,10 +434,26 @@ class StoreCoordinator(Coordinator):
         self._set_chunked(
             f"ag/{gen}/{self._rank}", pickle.dumps(obj, protocol=4), gen
         )
-        out = [
-            pickle.loads(self._get_chunked(f"ag/{gen}/{r}"))
-            for r in range(self._world)
-        ]
+        # Same shared-deadline discipline as barrier: self._timeout_s
+        # bounds the whole gather — a fresh budget per rank key (or per
+        # chunk part) would let the worst-case wait grow to world x
+        # timeout.
+        deadline = time.monotonic() + self._timeout_s
+        out = []
+        for r in range(self._world):
+            try:
+                out.append(
+                    pickle.loads(
+                        self._get_chunked(f"ag/{gen}/{r}", deadline)
+                    )
+                )
+            except TimeoutError:
+                raise TimeoutError(
+                    f"all_gather (generation {gen}) timed out after "
+                    f"{self._timeout_s:g}s total: rank {r} never "
+                    f"finished publishing its value (observed by rank "
+                    f"{self._rank} of {self._world})."
+                ) from None
         self._gc_through(gen)
         return out
 
@@ -386,7 +472,16 @@ class StoreCoordinator(Coordinator):
                 self._collect_broadcast_acks(block_oldest=True)
             return obj
         self._prune_consumed_acks()
-        out = pickle.loads(self._get_chunked(f"bc/{gen}"))
+        deadline = time.monotonic() + self._timeout_s
+        try:
+            out = pickle.loads(self._get_chunked(f"bc/{gen}", deadline))
+        except TimeoutError:
+            raise TimeoutError(
+                f"broadcast (generation {gen}) timed out after "
+                f"{self._timeout_s:g}s total: source rank {src} never "
+                f"finished publishing (receiving rank {self._rank} of "
+                f"{self._world})."
+            ) from None
         # Ack after the read completes: the source may delete the payload
         # keys the moment all acks exist. The ack is also tracked in
         # _own_keys so barrier/gather progress collects it if the source
@@ -439,8 +534,18 @@ class StoreCoordinator(Coordinator):
                 if r != self._rank
             ]
             if block_oldest and first:
+                deadline = time.monotonic() + self._timeout_s
                 for a in acks:
-                    self._store.get(a, self._timeout_s)
+                    try:
+                        self._store.get(a, self._remaining(deadline))
+                    except TimeoutError:
+                        raise TimeoutError(
+                            f"broadcast ack (generation {gen}) timed out "
+                            f"after {self._timeout_s:g}s total: rank "
+                            f"{a.rsplit('/', 1)[1]} never acknowledged "
+                            f"(source rank {self._rank} of "
+                            f"{self._world})."
+                        ) from None
                 first = False
             elif any(self._store.try_get(a) is None for a in acks):
                 return

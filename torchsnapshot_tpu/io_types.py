@@ -297,6 +297,14 @@ class StoragePlugin(abc.ABC):
         copy-alone admission."""
         return None
 
+    def ensure_durable(self) -> None:
+        """Make everything written through this plugin so far
+        crash-durable. The commit protocol calls this on EVERY rank
+        before the collective that leads to metadata publication, so a
+        backend may defer per-object durability work (e.g. directory
+        fsyncs) and settle it here in one batch. Default no-op: object
+        stores are durable on write-ack."""
+
     @abc.abstractmethod
     def close(self) -> None:
         ...
@@ -355,6 +363,9 @@ class RetryingStoragePlugin(StoragePlugin):
         return await retry_storage_op(
             lambda: self._inner.object_size_bytes(path), f"size({path})"
         )
+
+    def ensure_durable(self) -> None:
+        self._inner.ensure_durable()
 
     def close(self) -> None:
         self._inner.close()

@@ -98,7 +98,9 @@ def _infer_spec_padded(
     if spec is None:
         try:
             sharding = x.sharding
-        except Exception:
+        # Tracers hide .sharding; "no spec" degrades to the unsharded
+        # path, which is correct just slower.
+        except Exception:  # snapcheck: disable=swallowed-exception -- tracer probe
             sharding = None
         if isinstance(sharding, NamedSharding) and sharding.spec:
             spec = sharding.spec

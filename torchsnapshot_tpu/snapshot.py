@@ -403,6 +403,12 @@ class Snapshot:
                     )
                 )
             else:
+                # This route writes no per-rank storage marker, so it is
+                # each rank's last chance to settle deferred durability
+                # work (fs dirent fsyncs) BEFORE contributing to the
+                # gather below — rank 0 can publish metadata referencing
+                # this rank's objects the moment the gather completes.
+                storage.ensure_durable()
                 # The manifest all-gather doubles as the completion
                 # barrier: rank 0 holds every rank's manifest only after
                 # every rank finished its writes, so metadata-last
@@ -996,7 +1002,9 @@ class Snapshot:
                     return array_nbytes(
                         array_entry.dtype, array_entry.shape
                     )
-                except Exception:
+                # Unknown size only downgrades verify() to a
+                # checksum-less existence check for this entry.
+                except Exception:  # snapcheck: disable=swallowed-exception -- size estimate
                     return None
 
             # Dedup by location, but UPGRADE: the same replicated payload
@@ -1644,7 +1652,8 @@ def _save_stateful(
 def _safe_nbytes(value: Any) -> int:
     try:
         return int(getattr(value, "nbytes", 0) or 0)
-    except Exception:
+    # Size estimate for owner balancing only; 0 means "assign by path".
+    except Exception:  # snapcheck: disable=swallowed-exception -- size estimate
         return 0
 
 
@@ -1756,13 +1765,16 @@ async def _live_referencers(
             continue
         try:
             md = await _aread_metadata_at(ref_url)
-        except Exception:
+        # Absence IS the signal here (uncommitted referencer); the age
+        # guard below fails closed on every other failure mode.
+        except Exception:  # snapcheck: disable=swallowed-exception -- absence probe
             # No committed metadata: in-flight take or stale leftover —
             # distinguish by marker age, failing closed when unknown.
             if min_age_s > 0:
                 try:
                     age = await storage.object_age_s(marker_path)
-                except Exception:
+                # Unknown age fails CLOSED (treated as live) just below.
+                except Exception:  # snapcheck: disable=swallowed-exception -- fails closed
                     age = None
                 if age is None or age < min_age_s:
                     live.add(ref_url.rstrip("/"))
@@ -1922,7 +1934,9 @@ async def _read_valid_marker(
         candidate = SnapshotMetadata.from_yaml(
             _decode_metadata_doc(bytes(io_payload(io_req)), strict=False)
         )
-    except Exception:
+    # A torn half-committed document parses as garbage by DESIGN;
+    # "no candidate" keeps the poll going until the commit lands.
+    except Exception:  # snapcheck: disable=swallowed-exception -- torn-doc poll
         return None
     if candidate.take_id == nonce:
         return candidate
@@ -2009,7 +2023,8 @@ async def _wait_for_metadata(
                         bytes(io_payload(io_req)), strict=False
                     )
                 )
-            except Exception:
+            # Same torn-document contract as the nonce probe above.
+            except Exception:  # snapcheck: disable=swallowed-exception -- torn-doc poll
                 metadata = None  # partial/corrupt document: keep polling
             if metadata is not None and (
                 take_id is None or metadata.take_id == take_id
@@ -2307,7 +2322,8 @@ def _verify_restored_fingerprints(
         if entry.prng_impl is not None and isinstance(value, _jax.Array):
             try:
                 data = _jax.random.key_data(value)
-            except Exception:
+            # Typed-key unwrap probe; raw key data is fingerprintable.
+            except Exception:  # snapcheck: disable=swallowed-exception -- unwrap probe
                 pass  # already key data (or host-side): fingerprint as-is
         for slices, expected in specs:
             if expected is None:
@@ -2376,7 +2392,8 @@ def _verify_restored_fingerprints(
             from .serialization import str_to_dtype
 
             itemsize = _np.dtype(str_to_dtype(dtype_by_path[path])).itemsize
-        except Exception:
+        # Unknown itemsize takes the CONSERVATIVE branch (soft warning).
+        except Exception:  # snapcheck: disable=swallowed-exception -- conservative fallback
             itemsize = 0
         if itemsize == 4:
             if path not in mismatched:
@@ -2521,7 +2538,13 @@ async def _acommit_via_storage(
             try:
                 await storage.delete(f".completed/{take_id}/{r}")
             except Exception:
-                pass  # best-effort cleanup
+                # Best-effort cleanup of per-rank completion markers; a
+                # leftover marker is inert but worth a debug trace.
+                logger.debug(
+                    f"cleanup of completion marker "
+                    f".completed/{take_id}/{r} failed",
+                    exc_info=True,
+                )
         return metadata
     return None
 

@@ -62,14 +62,26 @@ def _resolve_plugin(url_path: str) -> StoragePlugin:
     try:
         eps = importlib_metadata.entry_points()
         if hasattr(eps, "select"):
-            group = eps.select(group="storage_plugins")
+            group = list(eps.select(group="storage_plugins"))
         else:  # pragma: no cover
-            group = eps.get("storage_plugins", [])
-        for ep in group:
-            if ep.name == protocol:
-                return ep.load()(path)
+            group = list(eps.get("storage_plugins", []))
     except Exception:
-        pass
+        # Broken entry-point metadata in some unrelated package must not
+        # mask the actionable "unsupported protocol" error below — but it
+        # must be visible, or a mispackaged environment looks identical
+        # to a missing plugin.
+        logger.warning(
+            f"Enumerating storage_plugins entry points for protocol "
+            f"{protocol!r} failed",
+            exc_info=True,
+        )
+        group = []
+    for ep in group:
+        if ep.name == protocol:
+            # The plugin IS installed: a load()/constructor failure is
+            # the real, actionable error — propagate it instead of
+            # demoting it to "unsupported protocol".
+            return ep.load()(path)
     raise RuntimeError(f"Unsupported protocol: {protocol}")
 
 

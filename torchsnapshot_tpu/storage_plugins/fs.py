@@ -9,10 +9,26 @@ fetches during resharding.
 """
 
 import asyncio
+import errno
 import os
+import threading
 from typing import Optional, Set, Tuple
 
 from ..io_types import IOReq, StoragePlugin
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError as e:
+        # Some filesystems (FUSE, 9p, network mounts) reject fsync on a
+        # directory fd; degrade to rename-only semantics there rather
+        # than failing a write whose data is already durable.
+        if e.errno not in (errno.EINVAL, errno.ENOTSUP):
+            raise
+    finally:
+        os.close(fd)
 
 
 class FSStoragePlugin(StoragePlugin):
@@ -25,23 +41,93 @@ class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
+        # Directories holding renamed-in data objects whose dirents have
+        # not been fsynced yet. Data-object writes only record their
+        # directory here; the fsyncs are paid once, at the next publish
+        # point (see _write_sync), instead of once per object.
+        self._dirty_dirs: Set[str] = set()
+        self._dirty_lock = threading.Lock()
 
     def _prepare_dir(self, path: str) -> None:
         dir_path = os.path.dirname(os.path.join(self.root, path))
-        if dir_path and dir_path not in self._dir_cache:
-            os.makedirs(dir_path, exist_ok=True)
-            self._dir_cache.add(dir_path)
+        if not dir_path or dir_path in self._dir_cache:
+            return
+        # Record which ancestors are about to be created BEFORE makedirs —
+        # including the root itself and anything above it makedirs will
+        # conjure — because afterwards there is no telling created from
+        # pre-existing. The new dirents must be durable: a crash could
+        # otherwise drop a directory whose (fsynced) files committed
+        # metadata already references. Each created dir's parent is
+        # fsynced once, top-downward; the cache makes it once per
+        # directory lifetime.
+        created = []
+        d = dir_path
+        while d and d not in self._dir_cache and not os.path.isdir(d):
+            created.append(d)
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        os.makedirs(dir_path, exist_ok=True)
+        for d in reversed(created):
+            _fsync_dir(os.path.dirname(d))
+            self._dir_cache.add(d)
+        self._dir_cache.add(dir_path)
+
+    @staticmethod
+    def _is_publish_point(path: str) -> bool:
+        """A write that makes previously written objects *referenced*:
+        snapshot metadata, commit/step markers — everything the protocol
+        keeps under dot-prefixed names. Data objects never are."""
+        first = path.split("/", 1)[0]
+        return first.startswith(".") or os.path.basename(path).startswith(".")
+
+    def _flush_dirty_dirs(self) -> None:
+        with self._dirty_lock:
+            dirty, self._dirty_dirs = self._dirty_dirs, set()
+        for d in sorted(dirty):
+            _fsync_dir(d)
+
+    def ensure_durable(self) -> None:
+        # Commit-protocol hook: ranks whose commit route writes no
+        # dot-prefixed marker of their own (the KV manifest-gather path)
+        # call this before contributing to the commit collective, so
+        # their deferred dirents are durable before rank 0 can publish
+        # metadata referencing them.
+        self._flush_dirty_dirs()
 
     def _write_sync(self, io_req: IOReq) -> None:
         self._prepare_dir(io_req.path)
         full = os.path.join(self.root, io_req.path)
+        publish = self._is_publish_point(io_req.path)
+        if publish:
+            # Every dirent this marker/metadata may reference must be
+            # durable BEFORE the publishing rename can reach disk —
+            # writeback gives no ordering on its own.
+            self._flush_dirty_dirs()
         # Write to a temp name then rename for per-object atomicity (the
         # reference has no partial-write protection; POSIX rename is free).
         tmp = f"{full}.tmp{os.getpid()}"
         payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
         with open(tmp, "wb") as f:
             f.write(payload)
+            # Data must be durable BEFORE the rename publishes the final
+            # name (snapcheck durability-order): a crash shortly after an
+            # un-fsynced rename can leave the published name pointing at
+            # torn/empty data that the metadata (written later) already
+            # references.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, full)
+        # The rename's dirent must be durable too — immediately for a
+        # publish point (it IS the commit), deferred to the next publish
+        # point for data objects (nothing references them until then, and
+        # one fsync per directory then covers every object in it).
+        if publish:
+            _fsync_dir(os.path.dirname(full))
+        else:
+            with self._dirty_lock:
+                self._dirty_dirs.add(os.path.dirname(full))
 
     def _read_sync(self, io_req: IOReq) -> None:
         full = os.path.join(self.root, io_req.path)
@@ -116,4 +202,7 @@ class FSStoragePlugin(StoragePlugin):
             return None
 
     def close(self) -> None:
-        pass
+        # Belt-and-braces: a plugin retired without ever hitting a
+        # publish point (e.g. an aborted take) still leaves every dirent
+        # it created durable.
+        self._flush_dirty_dirs()

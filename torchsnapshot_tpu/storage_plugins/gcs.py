@@ -9,12 +9,15 @@ overlapping byte ranges.
 """
 
 import asyncio
+import logging
 import os
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 from ..io_types import IOReq, StoragePlugin, io_payload
+
+logger = logging.getLogger(__name__)
 
 _IO_THREADS = 8
 
@@ -137,7 +140,13 @@ class GCSStoragePlugin(StoragePlugin):
                 try:
                     self._bucket.blob(k).delete()
                 except Exception:
-                    pass
+                    # Leaked parts cost storage, not correctness (the
+                    # sweep reclaims them); log so a systematically
+                    # failing cleanup is visible instead of silent.
+                    logger.warning(
+                        f"best-effort delete of upload part {k} failed",
+                        exc_info=True,
+                    )
 
             await asyncio.gather(
                 *(
