@@ -101,10 +101,18 @@ def _worker(
     if snap_path.startswith("memory://"):
         from torchsnapshot_tpu.storage_plugin import _MEMORY_STORES
 
+        # memory:// is hierarchical (bucket + key prefix): the store is
+        # keyed by the first path segment and this snapshot's objects
+        # carry the remainder as a key prefix.
         root = snap_path[len("memory://") :]
-        store = _MEMORY_STORES.get(root, {})
+        bucket, _, prefix = root.partition("/")
+        prefix = f"{prefix.rstrip('/')}/" if prefix else ""
+        store = _MEMORY_STORES.get(bucket, {})
         rank_bytes = sum(
-            len(v) for k, v in store.items() if not k.startswith(".snapshot")
+            len(v)
+            for k, v in store.items()
+            if k.startswith(prefix)
+            and not k[len(prefix) :].startswith(".snapshot")
         )
     out_queue.put(
         (rank, elapsed, model.total_bytes(), rank_bytes, inc_elapsed)

@@ -1,0 +1,334 @@
+"""Crash-consistency matrix: replay the save→commit→prune pipeline
+crashing at every storage-op boundary and assert the restore-or-detect
+invariant (docs/FAULTS.md) — the dynamic counterpart to snapcheck's
+static durability-ordering proof.
+
+Fast tier (``-m faultline``, runs in tier-1): a stride sample of crash
+points on both backends plus the targeted prune-phase and finalize
+scenarios. Full enumeration of every op boundary is also marked
+``slow``.
+"""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import CheckpointManager, StateDict
+from torchsnapshot_tpu import faultline as fl
+from torchsnapshot_tpu.manager import _PRUNING_PREFIX, _STEP_PREFIX
+
+pytestmark = pytest.mark.faultline
+
+
+def _state(v):
+    return {"s": StateDict(w=jnp.full((4,), float(v)))}
+
+
+def _target():
+    return {"s": StateDict(w=jnp.zeros((4,)))}
+
+
+def _probe(base):
+    def probe(step):
+        target = _target()
+        got = CheckpointManager(base).restore(target, step=step)
+        assert got == step
+        np.testing.assert_array_equal(
+            np.asarray(target["s"]["w"]), float(step)
+        )
+
+    return probe
+
+
+def _prepare_fs(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("crash") / "run")
+    mgr = CheckpointManager(base, max_to_keep=1)
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+    return base
+
+
+def _prepare_memory(_tmp_path_factory):
+    base = f"memory://crashmx-{uuid.uuid4().hex[:10]}/run"
+    mgr = CheckpointManager(base, max_to_keep=1)
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+    return base
+
+
+def _faulted(base):
+    # One full lifecycle op: take step 2, commit its marker, prune step 1.
+    CheckpointManager(base, max_to_keep=1).save(2, _state(2))
+
+
+def _check(base, outcome):
+    # (a)/(b): every visible marker restores clean; reconcile adopts
+    # committed-unmarked work (also verified restorable).
+    res = fl.check_recovery_invariant(base, _probe(base))
+    outcome.marked_steps = res.marked_steps
+    outcome.adopted_steps = res.adopted_steps
+    # Recovery re-drive: the next save→prune cycle must succeed and
+    # re-drive any interrupted prune; reconcile then reclaims crashed
+    # uncommitted takes; nothing may leak.
+    mgr = CheckpointManager(base, max_to_keep=1, reconcile_on_init="adopt")
+    mgr.save(3, _state(3))
+    mgr.reconcile(adopt=True)
+    assert mgr.latest_step() == 3
+    _probe(base)(3)
+    fl.assert_reclaimed(base, [3])
+
+
+_PREPARES = {"fs": _prepare_fs, "memory": _prepare_memory}
+
+
+@pytest.mark.parametrize("backend", ["fs", "memory"])
+def test_crash_matrix_fast_subset(backend, tmp_path_factory, monkeypatch):
+    """Stride-sampled crash points across the whole cycle (tier-1)."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    prepare = _PREPARES[backend]
+    base = prepare(tmp_path_factory)
+    total = fl.count_storage_ops(lambda: _faulted(base))
+    assert total > 0
+    # ~6 points spread over the op stream, always including the first
+    # and last boundaries (commit edges live there).
+    stride = max(1, total // 5)
+    points = sorted(set(range(1, total + 1, stride)) | {1, total})
+    report = fl.enumerate_crash_points(
+        lambda: prepare(tmp_path_factory),
+        _faulted,
+        _check,
+        points,
+        total_ops=total,
+    )
+    assert report.total_ops == total
+    assert set(report.outcomes) == set(points)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["fs", "memory"])
+def test_crash_matrix_full_enumeration(backend, tmp_path_factory, monkeypatch):
+    """EVERY storage-op boundary of the save→commit→prune cycle,
+    including fs.py's write→fsync→rename→dir-fsync sub-steps."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    prepare = _PREPARES[backend]
+    report = fl.enumerate_crash_points(
+        lambda: prepare(tmp_path_factory), _faulted, _check
+    )
+    assert len(report.outcomes) == report.total_ops
+    assert all(o.crashed for o in report.outcomes.values())
+    # The matrix must actually span the lifecycle: some crash points land
+    # before the take commits (step 2 invisible or adopted), some after
+    # (step 2 marked).
+    kinds = {
+        (2 in o.marked_steps, 2 in o.adopted_steps)
+        for o in report.outcomes.values()
+    }
+    assert (True, False) in kinds  # crashed after the marker commit
+    assert (False, False) in kinds or (False, True) in kinds  # before
+
+
+# ----------------------------------------------------- interrupted _prune
+
+
+def _prune_crash_scenario(tmp_path, monkeypatch, crash_rule):
+    """Build 2 committed steps, crash mid-prune of step 0 per
+    ``crash_rule``, and return the base path. max_to_keep=1 makes
+    save(1) prune step 0."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = str(tmp_path / "run")
+    CheckpointManager(base).save(0, _state(0))
+    sched = fl.FaultSchedule()
+    crash_rule(sched)
+    with fl.inject(sched) as ctl:
+        with pytest.raises(fl.SimulatedCrash):
+            CheckpointManager(base, max_to_keep=1).save(1, _state(1))
+    assert ctl.fault_counts().get("crash", 0) >= 1
+    return base
+
+
+def _assert_prune_redriven(base):
+    """The crashed prune's step is fully reclaimed by the NEXT cycle and
+    live steps survive with their values."""
+    # Live state immediately after the crash: step 1 committed, restorable.
+    mgr = CheckpointManager(base)
+    assert 1 in mgr.all_steps()
+    _probe(base)(1)
+    # Re-drive: the next save's prune finishes step 0's deletion (via
+    # tombstone or marker), prunes step 1, and leaves no debris.
+    mgr2 = CheckpointManager(base, max_to_keep=1, reconcile_on_init="adopt")
+    mgr2.save(2, _state(2))
+    mgr2.reconcile(adopt=True)
+    assert mgr2.all_steps() == [2]
+    _probe(base)(2)
+    fl.assert_reclaimed(base, [2])
+
+
+def test_prune_crash_before_tombstone_write(tmp_path, monkeypatch):
+    base = _prune_crash_scenario(
+        tmp_path,
+        monkeypatch,
+        lambda s: s.crash_on(op="write", path=f"{_PRUNING_PREFIX}0"),
+    )
+    # Nothing happened yet: step 0's marker must still resolve it.
+    assert CheckpointManager(base).all_steps() == [0, 1]
+    _probe(base)(0)
+    _assert_prune_redriven(base)
+
+
+def test_prune_crash_between_tombstone_and_marker_delete(
+    tmp_path, monkeypatch
+):
+    base = _prune_crash_scenario(
+        tmp_path,
+        monkeypatch,
+        lambda s: s.crash_on(op="delete", path=f"{_STEP_PREFIX}0"),
+    )
+    # Tombstone written, marker still visible: the step must STILL be
+    # fully restorable (payload deletion is ordered after marker delete).
+    assert CheckpointManager(base).all_steps() == [0, 1]
+    _probe(base)(0)
+    _assert_prune_redriven(base)
+
+
+def test_prune_crash_between_marker_delete_and_payload_delete(
+    tmp_path, monkeypatch
+):
+    # First payload-prefix delete is the step's metadata uncommit.
+    base = _prune_crash_scenario(
+        tmp_path,
+        monkeypatch,
+        lambda s: s.crash_on(op="delete", path=".snapshot_metadata"),
+    )
+    # Marker gone: step 0 is invisible (unresolvable) even though its
+    # payloads survive — and reconcile must NOT resurrect a condemned
+    # (tombstoned) step.
+    mgr = CheckpointManager(base)
+    assert mgr.all_steps() == [1]
+    assert mgr.reconcile(adopt=True) == []
+    assert mgr.all_steps() == [1]
+    _assert_prune_redriven(base)
+
+
+def test_prune_crash_mid_payload_deletes(tmp_path, monkeypatch):
+    base = _prune_crash_scenario(
+        tmp_path,
+        monkeypatch,
+        # Payload objects live under "<rank>/..." within the step root.
+        lambda s: s.crash_on(op="delete", path="0/*"),
+    )
+    assert CheckpointManager(base).all_steps() == [1]
+    _assert_prune_redriven(base)
+
+
+def test_prune_crash_before_tombstone_delete(tmp_path, monkeypatch):
+    base = _prune_crash_scenario(
+        tmp_path,
+        monkeypatch,
+        lambda s: s.crash_on(op="delete", path=f"{_PRUNING_PREFIX}0"),
+    )
+    # Payloads fully deleted; only the tombstone lingers. The next prune
+    # pass clears it.
+    assert CheckpointManager(base).all_steps() == [1]
+    _assert_prune_redriven(base)
+
+
+# ----------------------------------------- async finalize retriability
+
+
+def test_async_wait_retries_transient_marker_failure(tmp_path, monkeypatch):
+    """A transient marker-write failure during _finalize must leave the
+    step finalizable on the next wait(), not silently skipped."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    base = str(tmp_path / "run")
+    mgr = CheckpointManager(base, max_to_keep=2)
+    sched = fl.FaultSchedule().transient(
+        op="write", path=f"{_STEP_PREFIX}7", times=1
+    )
+    with fl.inject(sched) as ctl:
+        handle = mgr.async_save(7, _state(7))
+        with pytest.raises(fl.InjectedTransientError):
+            handle.wait()
+        # The snapshot itself committed; only the marker is missing.
+        assert mgr.latest_step() is None
+        snap = handle.wait()  # idempotent drain; _finalize retries
+    assert ctl.fault_counts() == {"transient": 1}
+    assert mgr.latest_step() == 7
+    _probe(base)(7)
+    assert snap.path.endswith("step-7")
+
+
+def test_async_wait_crash_orphan_adopted_by_reconcile(tmp_path, monkeypatch):
+    """Process death between the background commit and wait(): the step
+    is committed-but-unmarked, and reconcile(adopt=True) recovers it."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = str(tmp_path / "run")
+    mgr = CheckpointManager(base, max_to_keep=2)
+    sched = fl.FaultSchedule().crash_on(
+        op="write", path=f"{_STEP_PREFIX}3"
+    )
+    with fl.inject(sched):
+        handle = mgr.async_save(3, _state(3))
+        with pytest.raises(fl.SimulatedCrash):
+            handle.wait()
+    mgr2 = CheckpointManager(base)
+    assert mgr2.all_steps() == []
+    assert mgr2.reconcile(adopt=True) == [3]
+    assert mgr2.all_steps() == [3]
+    _probe(base)(3)
+
+
+# ------------------------------------------------- uncommitted-take debris
+
+
+@pytest.mark.parametrize("backend", ["fs", "memory"])
+def test_reconcile_reclaims_crashed_uncommitted_take(
+    backend, tmp_path, monkeypatch
+):
+    """A take that crashes before its commit point leaves payloads no
+    marker/metadata/tombstone will ever name; reconcile sweeps them."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    if backend == "fs":
+        base = str(tmp_path / "run")
+    else:
+        base = f"memory://uncmt-{uuid.uuid4().hex[:10]}/run"
+    mgr = CheckpointManager(base, max_to_keep=2)
+    mgr.save(0, _state(0))
+    sched = fl.FaultSchedule().crash_on(op="write", path=".snapshot_metadata")
+    with fl.inject(sched):
+        with pytest.raises(fl.SimulatedCrash):
+            CheckpointManager(base, max_to_keep=2).save(1, _state(1))
+    mgr2 = CheckpointManager(base)
+    assert mgr2.all_steps() == [0]  # detectably incomplete: unresolvable
+    handled = mgr2.reconcile(adopt=True)
+    assert 1 in handled  # reclaimed, not adopted (no commit point)
+    assert mgr2.all_steps() == [0]
+    fl.assert_reclaimed(base, [0])
+
+
+def test_reconcile_age_guard_spares_young_uncommitted_take(
+    tmp_path, monkeypatch
+):
+    """The sweep age guard must protect an in-flight take: young
+    uncommitted objects survive reconcile."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "3600")
+    base = str(tmp_path / "run")
+    mgr = CheckpointManager(base, max_to_keep=2)
+    mgr.save(0, _state(0))
+    sched = fl.FaultSchedule().crash_on(op="write", path=".snapshot_metadata")
+    with fl.inject(sched):
+        with pytest.raises(fl.SimulatedCrash):
+            CheckpointManager(base, max_to_keep=2).save(1, _state(1))
+    mgr2 = CheckpointManager(base)
+    handled = mgr2.reconcile(adopt=True)
+    assert 1 not in handled
+    leftovers = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(os.path.join(base, "step-1"))
+        for f in fs
+    ]
+    assert leftovers  # spared — it might be someone's in-progress take

@@ -1,0 +1,418 @@
+"""faultline unit tests: the fault-injection plugin, scriptable
+schedules, op-granular hooks (fs sub-steps), torn writes, latency,
+injected-fault tracing, and rank-fault injection for coordinator
+collectives (beyond reference parity — the reference has no fault
+model at all; PAPER.md §snapshot commit is the invariant under test)."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict, tracing
+from torchsnapshot_tpu import faultline as fl
+from torchsnapshot_tpu.coord import DictStore, StoreCoordinator
+from torchsnapshot_tpu.io_types import (
+    IOReq,
+    RetryingStoragePlugin,
+    add_storage_op_hook,
+    remove_storage_op_hook,
+)
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+pytestmark = pytest.mark.faultline
+
+
+def _state(v):
+    return {"s": StateDict(w=jnp.full((4,), float(v)))}
+
+
+def _target():
+    return {"s": StateDict(w=jnp.zeros((4,)))}
+
+
+# ------------------------------------------------------------- plugin unit
+
+
+def test_transient_faults_absorbed_by_retry_layer(monkeypatch):
+    """Injected 503s sit UNDER the retry layer: a take under two
+    transient write failures succeeds, and the controller logged both."""
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "3")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    sched = fl.FaultSchedule().transient(op="write", times=2)
+    with fl.inject(sched) as ctl:
+        store = MemoryStoragePlugin()
+        plugin = RetryingStoragePlugin(fl.FaultPlugin(store, ctl))
+        asyncio.run(plugin.write(IOReq(path="obj", data=b"payload")))
+    assert store.store["obj"] == b"payload"
+    assert ctl.fault_counts() == {"transient": 2}
+
+
+def test_permanent_fault_exhausts_retries(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "2")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    sched = fl.FaultSchedule().permanent(op="write", path="obj")
+    with fl.inject(sched) as ctl:
+        plugin = RetryingStoragePlugin(
+            fl.FaultPlugin(MemoryStoragePlugin(), ctl)
+        )
+        with pytest.raises(fl.InjectedPermanentError):
+            asyncio.run(plugin.write(IOReq(path="obj", data=b"x")))
+    assert ctl.fault_counts()["permanent"] == 3  # initial + 2 retries
+
+
+def test_torn_write_retry_rewrites_whole_object(monkeypatch):
+    """A torn write leaves a truncated object visible; the retry layer's
+    rewrite must replace it whole (whole-object puts are idempotent)."""
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    sched = fl.FaultSchedule().torn_write(path="obj", keep_bytes=3)
+    with fl.inject(sched) as ctl:
+        store = MemoryStoragePlugin()
+        plugin = RetryingStoragePlugin(fl.FaultPlugin(store, ctl))
+        asyncio.run(plugin.write(IOReq(path="obj", data=b"0123456789")))
+    assert store.store["obj"] == b"0123456789"
+    assert ctl.fault_counts()["torn"] == 1
+
+
+def test_torn_write_permanent_leaves_detectable_truncation(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    sched = fl.FaultSchedule().torn_write(
+        path="obj", keep_bytes=3, then="permanent", times=None
+    )
+    with fl.inject(sched) as ctl:
+        store = MemoryStoragePlugin()
+        plugin = RetryingStoragePlugin(fl.FaultPlugin(store, ctl))
+        with pytest.raises(fl.InjectedPermanentError):
+            asyncio.run(plugin.write(IOReq(path="obj", data=b"0123456789")))
+    assert store.store["obj"] == b"012"  # torn, and verifiably short
+
+
+def test_latency_injection_delays_op():
+    sched = fl.FaultSchedule().latency(op="write", seconds=0.05, times=1)
+    with fl.inject(sched) as ctl:
+        plugin = fl.FaultPlugin(MemoryStoragePlugin(), ctl)
+        begin = time.monotonic()
+        asyncio.run(plugin.write(IOReq(path="obj", data=b"x")))
+        assert time.monotonic() - begin >= 0.05
+    assert ctl.fault_counts() == {"latency": 1}
+
+
+def test_crash_is_base_exception_and_latches():
+    """SimulatedCrash must not be absorbable by `except Exception`
+    recovery paths, and every op after the crash point dies too."""
+    assert not issubclass(fl.SimulatedCrash, Exception)
+    sched = fl.FaultSchedule().crash_at(2)
+    with fl.inject(sched) as ctl:
+        plugin = fl.FaultPlugin(MemoryStoragePlugin(), ctl)
+        asyncio.run(plugin.write(IOReq(path="a", data=b"1")))  # op 1: fine
+        with pytest.raises(fl.SimulatedCrash):
+            asyncio.run(plugin.write(IOReq(path="b", data=b"2")))
+        with pytest.raises(fl.SimulatedCrash):
+            asyncio.run(plugin.read(IOReq(path="a")))
+        plugin.close()  # post-crash close is a silent no-op
+    assert ctl.crashed
+
+
+def test_nth_and_path_glob_targeting():
+    sched = fl.FaultSchedule().transient(op="delete", path=".steps/*", nth=2)
+    with fl.inject(sched) as ctl:
+        store = MemoryStoragePlugin()
+        plugin = fl.FaultPlugin(store, ctl)
+        for p in (".steps/1", "payload/x", ".steps/2", ".steps/3"):
+            asyncio.run(plugin.write(IOReq(path=p, data=b"1")))
+        asyncio.run(plugin.delete(".steps/1"))  # 1st match: passes
+        asyncio.run(plugin.delete("payload/x"))  # not a match
+        with pytest.raises(fl.InjectedTransientError):
+            asyncio.run(plugin.delete(".steps/2"))  # 2nd match: fires
+        asyncio.run(plugin.delete(".steps/3"))  # times=1 spent: passes
+
+
+def test_injected_transient_error_is_cloud_shaped():
+    """The injected 429/503 must classify as retryable, NOT as the
+    deterministic not-found/range errors the retry layer propagates."""
+    from torchsnapshot_tpu.io_types import (
+        is_not_found_error,
+        is_range_not_satisfiable_error,
+    )
+
+    for status in (429, 503):
+        e = fl.InjectedTransientError(status, "write", "x")
+        assert not is_not_found_error(e)
+        assert not is_range_not_satisfiable_error(e)
+
+
+# --------------------------------------------------------- op-granular hooks
+
+
+def test_fs_write_emits_substep_boundaries(tmp_path):
+    seen = []
+
+    def hook(op, path):
+        if op.startswith("fs."):
+            seen.append((op, path))
+
+    add_storage_op_hook(hook)
+    try:
+        plugin = FSStoragePlugin(str(tmp_path))
+        asyncio.run(plugin.write(IOReq(path="dir/obj", data=b"x")))
+        plugin.close()
+    finally:
+        remove_storage_op_hook(hook)
+    assert [op for op, _ in seen] == [
+        "fs.write.tmp",
+        "fs.write.fsync",
+        "fs.write.rename",
+        "fs.write.dirsync",
+    ]
+    assert all(p == "dir/obj" for _, p in seen)
+
+
+def test_crash_between_fsync_and_rename_leaves_uncommitted(tmp_path):
+    """Crash after the tmp payload is durable but before the rename: the
+    final name never appears — a torn PROTOCOL, not a torn object."""
+    path = str(tmp_path / "snap")
+    sched = fl.FaultSchedule().crash_on(op="fs.write.rename", path="0/s/w")
+    with fl.inject(sched):
+        with pytest.raises(fl.SimulatedCrash):
+            Snapshot.take(path, {"s": StateDict(w=jnp.arange(4.0))})
+    assert not os.path.exists(os.path.join(path, "0", "s", "w"))
+    assert not os.path.exists(
+        os.path.join(path, ".snapshot_metadata")
+    )  # metadata-last held: later ops never ran
+    with pytest.raises(FileNotFoundError):
+        Snapshot(path).restore({"s": StateDict(w=jnp.zeros(4))})
+
+
+def test_crash_after_marker_rename_still_restorable(tmp_path, monkeypatch):
+    """Crash after the step marker's rename sub-step: the marker is
+    visible, so invariant arm (a) applies — the step it names restores."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = str(tmp_path / "run")
+    sched = fl.FaultSchedule().crash_on(
+        op="fs.write.dirsync", path=".steps/0"
+    )
+    with fl.inject(sched):
+        with pytest.raises(fl.SimulatedCrash):
+            CheckpointManager(base, max_to_keep=2).save(0, _state(0))
+    mgr = CheckpointManager(base)
+    assert mgr.all_steps() == [0]
+    target = _target()
+    assert mgr.restore(target) == 0
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), 0.0)
+
+
+# ------------------------------------------------------------ fault tracing
+
+
+def test_injected_faults_emit_trace_instants(tmp_path, monkeypatch):
+    """Every injected fault lands in the trace next to the retry layer's
+    storage_retry instants, so traces show recovery behavior."""
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "3")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    trace_path = str(tmp_path / "trace.json")
+    tracing.enable(trace_path)
+    try:
+        sched = fl.FaultSchedule().transient(op="write", path="obj", times=2)
+        with fl.inject(sched) as ctl:
+            plugin = RetryingStoragePlugin(
+                fl.FaultPlugin(MemoryStoragePlugin(), ctl)
+            )
+            asyncio.run(plugin.write(IOReq(path="obj", data=b"x")))
+    finally:
+        tracing.flush()
+        tracing.disable()
+    with open(trace_path) as f:
+        events = json.load(f)["traceEvents"]
+    faults = [e for e in events if e["name"] == "fault_injected"]
+    retries = [e for e in events if e["name"] == "storage_retry"]
+    assert len(faults) == 2
+    assert {f["args"]["kind"] for f in faults} == {"transient"}
+    assert {f["args"]["op"] for f in faults} == {"write"}
+    assert all("op_index" in f["args"] for f in faults)
+    # The retry layer retried both failures and recorded each attempt.
+    assert len(retries) == 2
+    assert all(
+        r["args"]["error"] == "InjectedTransientError" for r in retries
+    )
+
+
+# ------------------------------------------------------- rank-fault injection
+
+
+def test_barrier_names_rank_that_never_published():
+    """A rank whose barrier arrival never becomes visible (process death
+    after the local call) must be NAMED by every healthy rank's shared-
+    deadline TimeoutError — not hang them, not blame a healthy peer."""
+    world = 3
+    store = fl.MuteRankStore(DictStore(), rank=1)
+    messages = [None] * world
+
+    def run(rank):
+        coord = StoreCoordinator(store, rank, world, timeout_s=0.5)
+        with pytest.raises(TimeoutError) as exc_info:
+            coord.barrier()
+        messages[rank] = str(exc_info.value)
+
+    threads = [
+        threading.Thread(target=run, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+    for rank, msg in enumerate(messages):
+        assert msg is not None, f"rank {rank} did not time out"
+        assert "rank 1" in msg and "never arrived" in msg
+        assert "rank 0" not in msg.split("observed by")[0]
+    assert store.dropped  # the fault actually fired
+
+
+def test_all_gather_names_all_stalled_ranks():
+    """With TWO muted ranks the error must name both — at pod scale
+    "ranks 1, 3" localizes a failure that "rank 1" alone does not."""
+    world = 4
+    store = fl.MuteRankStore(
+        DictStore(),
+        rank=-1,
+        patterns=fl.mute_patterns_for_rank(1)
+        + fl.mute_patterns_for_rank(3),
+    )
+    messages = {}
+    lock = threading.Lock()
+
+    def run(rank):
+        coord = StoreCoordinator(store, rank, world, timeout_s=0.5)
+        with pytest.raises(TimeoutError) as exc_info:
+            coord.all_gather_object(rank)
+        with lock:
+            messages[rank] = str(exc_info.value)
+
+    threads = [
+        threading.Thread(target=run, args=(r,)) for r in (0, 2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+    for rank in (0, 2):
+        msg = messages[rank]
+        assert "ranks 1, 3" in msg and "never finished publishing" in msg
+
+
+def test_partial_chunked_publish_reads_as_never_finished():
+    """A rank that dies partway into a chunked publish (head visible,
+    parts missing) must read as "never finished publishing", never as
+    garbage handed to pickle."""
+    world = 2
+    big = b"x" * (3 << 20)  # > _CHUNK: forces the chunked path
+    store = fl.MuteRankStore(DictStore(), rank=1, mute_after=1)
+    messages = {}
+
+    def run0():
+        coord = StoreCoordinator(store, 0, world, timeout_s=0.8)
+        with pytest.raises(TimeoutError) as exc_info:
+            coord.all_gather_object(b"small")
+        messages[0] = str(exc_info.value)
+
+    def run1():
+        coord = StoreCoordinator(store, 1, world, timeout_s=0.8)
+        with pytest.raises(TimeoutError):
+            coord.all_gather_object(big)
+
+    threads = [
+        threading.Thread(target=run0),
+        threading.Thread(target=run1),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+    assert "rank 1" in messages[0]
+    assert "never finished publishing" in messages[0]
+
+
+# ------------------------------------------------------------- op counting
+
+
+def test_count_storage_ops_is_fault_free():
+    store_url = f"memory://countbkt-{os.getpid()}"
+
+    def scenario():
+        Snapshot.take(store_url, {"s": StateDict(w=jnp.arange(4.0))})
+
+    n = fl.count_storage_ops(scenario)
+    assert n > 0
+    # The dry run really committed (no faults were injected).
+    target = _target()
+    Snapshot(store_url).restore({"s": target["s"]})
+
+
+def test_fmt_ranks_compresses_contiguous_spans():
+    """Pod-scale stalls must read as spans ("ranks 17, 40-63"), not a
+    thousands-entry comma list."""
+    fmt = StoreCoordinator._fmt_ranks
+    assert fmt([17]) == "rank 17"
+    assert fmt([1, 3]) == "ranks 1, 3"
+    assert fmt([1, 2, 3, 7]) == "ranks 1-3, 7"
+    assert fmt([17] + list(range(40, 64))) == "ranks 17, 40-63"
+
+
+def test_stale_tmp_cleanup_spares_live_writer(tmp_path):
+    """Publish-point stale-tmp cleanup removes a DEAD writer's torn tmp
+    but must never delete a live concurrent writer's in-flight tmp —
+    that would turn a safe last-rename-wins race into a non-retryable
+    FileNotFoundError on the peer's os.replace."""
+    import subprocess
+
+    # A dead pid: a subprocess that already exited (not yet recycled).
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    dead = proc.pid
+
+    plugin = FSStoragePlugin(str(tmp_path))
+    os.makedirs(str(tmp_path / ".steps"), exist_ok=True)
+    # A live "writer": pid 1 always exists (answers the liveness probe
+    # with EPERM in a container), standing in for a concurrent process
+    # mid-write of the same marker.
+    live_tmp = str(tmp_path / ".steps" / "5.tmp1")
+    dead_tmp = str(tmp_path / ".steps" / f"5.tmp{dead}")
+    for p in (live_tmp, dead_tmp):
+        with open(p, "wb") as f:
+            f.write(b"torn")
+    asyncio.run(plugin.write(IOReq(path=".steps/5", data=b"marker")))
+    plugin.close()
+    assert os.path.exists(live_tmp)  # live writer's tmp survives
+    assert not os.path.exists(dead_tmp)  # crashed writer's tmp removed
+    with open(str(tmp_path / ".steps" / "5"), "rb") as f:
+        assert f.read() == b"marker"
+
+
+def test_crash_on_close_boundary_skips_deferred_durability():
+    """close IS an op boundary: a crash scheduled there dies before the
+    inner plugin settles deferred work, and stays dead."""
+    sched = fl.FaultSchedule().crash_on(op="close")
+    with fl.inject(sched) as ctl:
+        plugin = fl.FaultPlugin(MemoryStoragePlugin(), ctl)
+        asyncio.run(plugin.write(IOReq(path="a", data=b"1")))
+        with pytest.raises(fl.SimulatedCrash):
+            plugin.close()
+        plugin.close()  # post-crash: silent no-op
+    assert ctl.crashed

@@ -387,3 +387,122 @@ def test_delete_sweep_tolerates_corrupt_metadata(tmp_path, monkeypatch):
     assert [
         os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
     ] == []
+
+
+# ---------------------------------------------------- backoff jitter/budget
+
+
+def test_retry_backoff_is_jittered_and_capped(monkeypatch):
+    """Delays must be decorrelated (drawn from [initial, prev*3]) and
+    capped — all ranks backing off in lockstep re-hammer recovering
+    shared storage at exactly the wrong moments."""
+    from torchsnapshot_tpu import io_types
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "6")
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRY_CAP_S", "0.004")
+    monkeypatch.setattr(io_types, "_RETRY_BACKOFF_INITIAL_S", 0.001)
+    delays = []
+    real_sleep = asyncio.sleep
+
+    async def capture_sleep(d):
+        delays.append(d)
+        await real_sleep(0)
+
+    monkeypatch.setattr(io_types.asyncio, "sleep", capture_sleep)
+
+    calls = []
+
+    async def _flaky():
+        calls.append(1)
+        if len(calls) < 7:
+            raise ConnectionResetError("down")
+        return "ok"
+
+    assert asyncio.run(retry_storage_op(_flaky, "write(j)")) == "ok"
+    assert len(delays) == 6
+    cap = 0.004
+    initial = 0.001
+    prev = initial
+    for d in delays:
+        assert initial <= d <= cap + 1e-9, delays
+        assert d <= max(initial, prev * 3.0) + 1e-9, delays
+        prev = d
+
+
+def test_retry_budget_bounds_total_episode(monkeypatch):
+    """With the elapsed budget at 0 the first failure propagates without
+    any sleep: retrying past the budget would pin commits for
+    attempts x cap seconds."""
+    from torchsnapshot_tpu import io_types
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "5")
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRY_BUDGET_S", "0")
+    slept = []
+
+    async def capture_sleep(d):
+        slept.append(d)
+
+    monkeypatch.setattr(io_types.asyncio, "sleep", capture_sleep)
+    calls = []
+
+    async def _always_fail():
+        calls.append(1)
+        raise ConnectionResetError("down")
+
+    with pytest.raises(ConnectionResetError):
+        asyncio.run(retry_storage_op(_always_fail, "write(b)"))
+    assert len(calls) == 1
+    assert slept == []
+
+
+def test_retry_attempts_emit_trace_instants(tmp_path, monkeypatch):
+    """Every retry attempt lands in the trace (op, attempt, delay,
+    error) so traces show recovery behavior, not just the final state."""
+    from torchsnapshot_tpu import io_types
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "3")
+    monkeypatch.setattr(io_types, "_RETRY_BACKOFF_INITIAL_S", 0.001)
+    trace_path = str(tmp_path / "trace.json")
+    tracing.enable(trace_path)
+    try:
+        inner = FlakyStorage(fail_n=2)
+        storage = RetryingStoragePlugin(inner)
+        asyncio.run(storage.write(IOReq(path="obj", data=b"payload")))
+    finally:
+        tracing.flush()
+        tracing.disable()
+    with open(trace_path) as f:
+        events = json.load(f)["traceEvents"]
+    retries = [e for e in events if e["name"] == "storage_retry"]
+    assert len(retries) == 2
+    assert [r["args"]["attempt"] for r in retries] == [1, 2]
+    for r in retries:
+        assert r["args"]["op"] == "write(obj)"
+        assert r["args"]["delay_s"] > 0
+        assert r["args"]["error"] == "ConnectionResetError"
+
+
+def test_retry_cap_below_initial_backoff_is_honored(monkeypatch):
+    """A cap below the initial backoff must still bound every delay —
+    the jitter floor drops to the cap, the cap never rises."""
+    from torchsnapshot_tpu import io_types
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "3")
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRY_CAP_S", "0.005")
+    delays = []
+
+    async def capture_sleep(d):
+        delays.append(d)
+
+    monkeypatch.setattr(io_types.asyncio, "sleep", capture_sleep)
+    calls = []
+
+    async def _flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("down")
+        return "ok"
+
+    assert asyncio.run(retry_storage_op(_flaky, "write(c)")) == "ok"
+    assert len(delays) == 2
+    assert all(0 < d <= 0.005 for d in delays), delays

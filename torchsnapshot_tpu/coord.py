@@ -405,6 +405,36 @@ class StoreCoordinator(Coordinator):
             for i in range(n)
         )
 
+    def _absent_ranks(self, key_fmt: str, first: int) -> List[int]:
+        """``first`` plus every later rank whose key is absent *now* — a
+        non-blocking sweep so a timeout error names EVERY straggler (at
+        pod scale "ranks 17, 40-63" localizes the failure; "rank 17"
+        alone does not). A false absent from a remote-store hiccup only
+        over-names the report; the operation already failed."""
+        missing = [first]
+        for r in range(first + 1, self._world):
+            if self._store.try_get(key_fmt.format(rank=r)) is None:
+                missing.append(r)
+        return missing
+
+    @staticmethod
+    def _fmt_ranks(ranks: List[int]) -> str:
+        """``[17]`` → "rank 17"; ``[1,2,3,7]`` → "ranks 1-3, 7". Runs
+        compress to ranges so a pod-scale stall (thousands of absent
+        ranks) reads as a handful of spans, not a 10 KB comma list."""
+        if len(ranks) == 1:
+            return f"rank {ranks[0]}"
+        spans = []
+        start = prev = ranks[0]
+        for r in ranks[1:]:
+            if r == prev + 1:
+                prev = r
+                continue
+            spans.append(f"{start}-{prev}" if prev > start else str(start))
+            start = prev = r
+        spans.append(f"{start}-{prev}" if prev > start else str(start))
+        return "ranks " + ", ".join(spans)
+
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         wait = self._timeout_s if timeout_s is None else timeout_s
         gen = self._next_gen()
@@ -413,19 +443,20 @@ class StoreCoordinator(Coordinator):
         self._own_keys.append((gen, key))
         # One shared deadline for the whole barrier, not a fresh timeout
         # per rank: the caller's timeout bounds the OPERATION (a per-rank
-        # budget would let the total wait grow to world x timeout), and a
-        # rank that never arrives is named in the error instead of
+        # budget would let the total wait grow to world x timeout), and
+        # every rank that never arrives is named in the error instead of
         # surfacing as an opaque store-key timeout.
         deadline = time.monotonic() + wait
         for r in range(self._world):
             try:
                 self._store.get(f"b/{gen}/{r}", self._remaining(deadline))
             except TimeoutError:
+                missing = self._absent_ranks(f"b/{gen}/{{rank}}", r)
                 raise TimeoutError(
                     f"barrier (generation {gen}) timed out after "
-                    f"{wait:g}s: rank {r} never arrived (observed by "
-                    f"rank {self._rank} of {self._world}). That rank "
-                    f"has likely crashed or is stuck in storage IO."
+                    f"{wait:g}s: {self._fmt_ranks(missing)} never arrived "
+                    f"(observed by rank {self._rank} of {self._world}); "
+                    f"likely crashed or stuck in storage IO."
                 ) from None
         self._gc_through(gen)
 
@@ -448,10 +479,12 @@ class StoreCoordinator(Coordinator):
                     )
                 )
             except TimeoutError:
+                missing = self._absent_ranks(f"ag/{gen}/{{rank}}", r)
                 raise TimeoutError(
                     f"all_gather (generation {gen}) timed out after "
-                    f"{self._timeout_s:g}s total: rank {r} never "
-                    f"finished publishing its value (observed by rank "
+                    f"{self._timeout_s:g}s total: "
+                    f"{self._fmt_ranks(missing)} never "
+                    f"finished publishing (observed by rank "
                     f"{self._rank} of {self._world})."
                 ) from None
         self._gc_through(gen)

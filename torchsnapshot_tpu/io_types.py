@@ -18,13 +18,50 @@ import asyncio
 import io
 import logging
 import os
+import random
+import time
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Union
+from typing import Callable, List, Optional, Union
+
+from . import tracing
 
 BufferType = Union[bytes, bytearray, memoryview]
 
 logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------- storage-op hooks
+#
+# Observation seam for every storage-op boundary. Registered hooks receive
+# ``(op, path)`` right before the op executes: plugin-level ops are emitted
+# by wrappers (faultline's FaultPlugin emits "write"/"read"/"delete"/...),
+# and backends with multi-step durability protocols emit their SUB-step
+# boundaries too (fs.py emits "fs.write.tmp" → "fs.write.fsync" →
+# "fs.write.rename" → "fs.write.dirsync"), so a fault-injection harness can
+# place a crash BETWEEN the steps of a single logical write. A hook may
+# raise — the exception propagates into the op exactly where a real failure
+# (or process death) would strike. Zero cost when no hook is registered
+# (one truthiness check per boundary).
+
+_STORAGE_OP_HOOKS: List[Callable[[str, str], None]] = []
+
+
+def add_storage_op_hook(hook: Callable[[str, str], None]) -> None:
+    """Register ``hook(op, path)`` to observe every storage-op boundary."""
+    _STORAGE_OP_HOOKS.append(hook)
+
+
+def remove_storage_op_hook(hook: Callable[[str, str], None]) -> None:
+    """Unregister a hook added by :func:`add_storage_op_hook`."""
+    _STORAGE_OP_HOOKS.remove(hook)
+
+
+def emit_storage_op(op: str, path: str) -> None:
+    """Announce a storage-op boundary to registered hooks (may raise)."""
+    if _STORAGE_OP_HOOKS:
+        for hook in list(_STORAGE_OP_HOOKS):
+            hook(op, path)
 
 
 def _code_attr_http_status(exc: BaseException) -> Optional[int]:
@@ -128,9 +165,26 @@ def is_range_not_satisfiable_error(exc: BaseException) -> bool:
 # retries anywhere — one transient object-store 5xx aborts the whole
 # snapshot, SURVEY §5). Writes are whole-object puts, reads are (ranged)
 # gets, deletes are idempotent — all safe to retry.
+#
+# Backoff is decorrelated-jitter (each delay drawn uniformly from
+# [initial, prev*3], capped): pure exponential backoff keeps every rank
+# of a pod on the SAME schedule, so after a shared-storage brownout all
+# ranks re-hammer the recovering service in lockstep at exactly the
+# moments it tries to come back. Jitter spreads the herd; the per-delay
+# cap bounds any single wait; the elapsed budget bounds the whole retry
+# episode so a permanently-failing op cannot pin a commit for
+# attempts × cap seconds.
 _STORAGE_RETRIES_ENV_VAR = "TPUSNAPSHOT_STORAGE_RETRIES"
 _DEFAULT_STORAGE_ATTEMPTS = 3
 _RETRY_BACKOFF_INITIAL_S = 0.25
+_RETRY_DELAY_CAP_ENV_VAR = "TPUSNAPSHOT_STORAGE_RETRY_CAP_S"
+_DEFAULT_RETRY_DELAY_CAP_S = 20.0
+_RETRY_BUDGET_ENV_VAR = "TPUSNAPSHOT_STORAGE_RETRY_BUDGET_S"
+_DEFAULT_RETRY_BUDGET_S = 600.0
+
+# Deliberately unseeded: the whole point is that concurrent ranks draw
+# DIFFERENT delays. Never feeds serialization or cross-rank decisions.
+_retry_rng = random.Random()
 
 
 def _storage_attempts() -> int:
@@ -142,11 +196,25 @@ def _storage_attempts() -> int:
 
 
 async def retry_storage_op(make_coro, desc: str):
-    """Run ``await make_coro()`` with exponential backoff on transient
-    failures. ``make_coro`` is a zero-arg callable returning a fresh
-    coroutine (a coroutine object cannot be awaited twice)."""
+    """Run ``await make_coro()`` with capped, decorrelated-jitter backoff
+    on transient failures, under an overall elapsed budget
+    (``TPUSNAPSHOT_STORAGE_RETRY_BUDGET_S``). ``make_coro`` is a zero-arg
+    callable returning a fresh coroutine (a coroutine object cannot be
+    awaited twice)."""
+    from .utils.env import env_float
+
     attempts = _storage_attempts()
-    delay = _RETRY_BACKOFF_INITIAL_S
+    cap = env_float(_RETRY_DELAY_CAP_ENV_VAR, _DEFAULT_RETRY_DELAY_CAP_S)
+    if cap <= 0:
+        cap = _DEFAULT_RETRY_DELAY_CAP_S
+    # A cap below the initial backoff wins: the knob must keep meaning
+    # "no single wait exceeds this" across its whole range, so the
+    # jitter floor drops to the cap rather than the cap rising to the
+    # floor (which would silently ignore sub-initial settings).
+    floor = min(_RETRY_BACKOFF_INITIAL_S, cap)
+    budget_s = env_float(_RETRY_BUDGET_ENV_VAR, _DEFAULT_RETRY_BUDGET_S)
+    start = time.monotonic()
+    prev_delay = floor
     for attempt in range(1, attempts + 1):
         try:
             return await make_coro()
@@ -159,12 +227,32 @@ async def retry_storage_op(make_coro, desc: str):
                 or attempt == attempts
             ):
                 raise
+            # Decorrelated jitter: uniform over [floor, prev*3], capped.
+            delay = min(
+                cap,
+                _retry_rng.uniform(floor, max(floor, prev_delay * 3.0)),
+            )
+            prev_delay = delay
+            elapsed = time.monotonic() - start
+            if elapsed + delay > budget_s:
+                logger.warning(
+                    f"Storage op {desc} failed (attempt {attempt}/"
+                    f"{attempts}): {e!r}; retry budget exhausted "
+                    f"({elapsed:.1f}s elapsed of {budget_s:g}s) — giving up"
+                )
+                raise
+            tracing.instant(
+                "storage_retry",
+                op=desc,
+                attempt=attempt,
+                delay_s=round(delay, 4),
+                error=type(e).__name__,
+            )
             logger.warning(
                 f"Storage op {desc} failed (attempt {attempt}/{attempts}): "
                 f"{e!r}; retrying in {delay:.2f}s"
             )
             await asyncio.sleep(delay)
-            delay *= 2
 
 
 class BufferStager(abc.ABC):

@@ -33,6 +33,7 @@ import logging
 import os
 from typing import Any, List, Optional
 
+from . import tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .io_types import IOReq, is_not_found_error
 from .snapshot import (
@@ -183,6 +184,17 @@ class CheckpointManager:
         adopting one would resurrect a checkpoint the retention policy
         already condemned.
 
+        Beyond orphans, reconcile is also the debris janitor (in BOTH
+        modes): step prefixes holding payload objects but no committed
+        metadata, no marker, and no tombstone — a take that crashed
+        before its commit point, which nothing else can ever resolve or
+        reclaim — are swept via ``Snapshot.delete(sweep=True)`` (each
+        object individually protected by the
+        ``TPUSNAPSHOT_SWEEP_MIN_AGE_S`` guard, so an in-flight take is
+        never destroyed), and torn control-file debris under
+        ``.steps/``/``.pruning/`` (``<n>.tmp<pid>`` leftovers from an fs
+        crash mid-marker-write) is removed under the same age guard.
+
         Storage-only and single-process (like :meth:`all_steps`): run it
         from one rank — typically at job startup before the first
         ``restore`` — or from an offline tool. Cost is one listing of
@@ -267,9 +279,101 @@ class CheckpointManager:
                     )
                     logger.info(f"reconcile: swept orphan step {step}")
                     handled.append(step)
+            handled.extend(
+                self._reclaim_uncommitted(
+                    storage, objs, committed, marked, tombstoned
+                )
+            )
+            self._clean_torn_control_files(storage)
             return handled
         finally:
             storage.close()
+
+    def _reclaim_uncommitted(
+        self, storage: Any, objs, committed, marked, tombstoned
+    ) -> List[int]:
+        """Sweep step prefixes that hold objects but no commit point.
+
+        A take that crashed before writing ``.snapshot_metadata`` leaves
+        payloads that no marker, no metadata, and no tombstone will ever
+        name — invisible to ``latest_step``/``restore`` (detectably
+        incomplete, the crash-consistency invariant's "detect" arm) but
+        also invisible to retention, so only this pass can reclaim the
+        bytes. The sweep delete age-guards every object
+        (``TPUSNAPSHOT_SWEEP_MIN_AGE_S``): a concurrent in-progress take
+        at the same step is spared, and a retry later reclaims it once
+        aged. Returns the steps whose prefixes came out empty."""
+        import re
+
+        reclaimed: List[int] = []
+        step_pat = re.compile(r"^step-(\d+)/")
+        seen = set()
+        for obj in objs:
+            m = step_pat.match(obj)
+            if m:
+                seen.add(int(m.group(1)))
+        for step in sorted(seen - committed - marked - tombstoned):
+            try:
+                Snapshot(_step_dir(self.base_path, step)).delete(sweep=True)
+                remaining = asyncio.run(
+                    storage.list_prefix(f"step-{step}/")
+                )
+            except Exception as e:
+                logger.warning(
+                    f"reconcile: reclaiming uncommitted step {step} "
+                    f"failed ({e!r}); retried on the next reconcile."
+                )
+                continue
+            if remaining:
+                logger.info(
+                    f"reconcile: uncommitted step {step}: "
+                    f"{len(remaining)} object(s) spared by the sweep age "
+                    f"guard; retried on the next reconcile."
+                )
+            else:
+                logger.info(
+                    f"reconcile: reclaimed uncommitted step {step}"
+                )
+                reclaimed.append(step)
+        return reclaimed
+
+    def _clean_torn_control_files(self, storage: Any) -> None:
+        """Remove ``<n>.tmp<pid>`` debris under ``.steps/``/``.pruning/``
+        — a crash between the fs plugin's tmp-write and rename sub-steps
+        leaves one, and no marker/tombstone path ever resolves it (it
+        merely triggers a malformed-marker warning on every listing).
+        Age-guarded like every sweep."""
+        import re
+
+        min_age_s = env_float("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600.0)
+        for prefix in (_STEP_PREFIX, _PRUNING_PREFIX):
+            for obj in asyncio.run(storage.list_prefix(prefix)) or []:
+                tail = obj[len(prefix):]
+                if not re.fullmatch(r"\d+\.tmp\d+", tail):
+                    continue
+                if min_age_s > 0:
+                    try:
+                        age = asyncio.run(storage.object_age_s(obj))
+                    except Exception as e:
+                        logger.warning(
+                            f"reconcile: sparing torn control file {obj} "
+                            f"(age probe failed: {e!r})"
+                        )
+                        continue
+                    # Unknown age fails closed, same as every sweep guard.
+                    if age is None or age < min_age_s:
+                        continue
+                try:
+                    asyncio.run(storage.delete(obj))
+                    logger.info(
+                        f"reconcile: removed torn control file {obj}"
+                    )
+                except Exception as e:
+                    if not is_not_found_error(e):
+                        logger.warning(
+                            f"reconcile: removing torn control file "
+                            f"{obj} failed ({e!r})"
+                        )
 
     # -------------------------------------------------------------- save
 
@@ -377,6 +481,10 @@ class CheckpointManager:
                         _step_dir(self.base_path, step).encode()
                     )
                     asyncio.run(storage.write(marker))
+                    # Manager-level commit milestone (the snapshot-level
+                    # one is metadata_committed): from here the step is
+                    # resolvable and must restore clean under any crash.
+                    tracing.instant("step_marker_committed", step=step)
             finally:
                 # The marker write above can legitimately outlast the
                 # store's default wait (storage retries + backoff over a

@@ -2555,6 +2555,15 @@ async def _awrite_snapshot_metadata(
     io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
     io_req.buf.write(_encode_metadata_doc(metadata.to_yaml()))
     await storage.write(io_req)
+    # Commit-milestone instant: in a fault/recovery trace this is the
+    # line between "interrupted take, detectably incomplete" and
+    # "committed snapshot that must restore clean" (docs/FAULTS.md) —
+    # storage_retry/fault_injected instants before it are pre-commit.
+    tracing.instant(
+        "metadata_committed",
+        take_id=metadata.take_id or "",
+        world_size=metadata.world_size,
+    )
 
 
 def _write_snapshot_metadata(storage: StoragePlugin, metadata: SnapshotMetadata) -> None:

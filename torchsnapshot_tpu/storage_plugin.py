@@ -15,7 +15,7 @@ snapshot's own storage root.
 
 import logging
 from importlib import metadata as importlib_metadata
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .io_types import IOReq, RetryingStoragePlugin, StoragePlugin
 from .storage_plugins.fs import FSStoragePlugin
@@ -28,12 +28,34 @@ logger = logging.getLogger(__name__)
 # staging targets).
 _MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
 
+# Fault-injection seam (torchsnapshot_tpu.faultline): when set, every
+# resolved backend is passed through this wrapper BEFORE the retry layer,
+# so injected transient failures exercise the real retry policy while an
+# injected crash (a BaseException) rips straight through it — the same
+# layering a real backend failure or process death would see. Process-
+# global on purpose: take/finalize/prune each resolve their own plugin
+# instance, and one controller must observe them all as one op stream.
+_PLUGIN_WRAP_HOOK: Optional[Callable[[StoragePlugin, str], StoragePlugin]] = None
+
+
+def set_plugin_wrap_hook(hook):
+    """Install (or, with None, clear) the plugin wrapper applied to every
+    backend ``url_to_storage_plugin`` resolves; returns the previous hook
+    so callers can restore it."""
+    global _PLUGIN_WRAP_HOOK
+    prev = _PLUGIN_WRAP_HOOK
+    _PLUGIN_WRAP_HOOK = hook
+    return prev
+
 
 def url_to_storage_plugin(url_path: str) -> StoragePlugin:
     """Resolve a URL to its backend, wrapped with the retry policy (every
     storage op — payloads, metadata commit, markers, deletes — retries
     transient failures; see io_types.retry_storage_op)."""
-    return RetryingStoragePlugin(_resolve_plugin(url_path))
+    plugin = _resolve_plugin(url_path)
+    if _PLUGIN_WRAP_HOOK is not None:
+        plugin = _PLUGIN_WRAP_HOOK(plugin, url_path)
+    return RetryingStoragePlugin(plugin)
 
 
 def _resolve_plugin(url_path: str) -> StoragePlugin:
@@ -47,8 +69,13 @@ def _resolve_plugin(url_path: str) -> StoragePlugin:
     if protocol == "fs":
         return FSStoragePlugin(root=path)
     if protocol == "memory":
-        store = _MEMORY_STORES.setdefault(path, {})
-        return MemoryStoragePlugin(store=store)
+        # Hierarchical, like a real object store: the first path segment
+        # names the bucket, the rest is a key prefix within it — so
+        # memory://run and memory://run/step-0 share one bucket and the
+        # base root can enumerate the step's objects.
+        bucket, _, prefix = path.partition("/")
+        store = _MEMORY_STORES.setdefault(bucket, {})
+        return MemoryStoragePlugin(store=store, prefix=prefix)
     if protocol == "gs":
         from .storage_plugins.gcs import GCSStoragePlugin
 

@@ -14,7 +14,7 @@ import os
 import threading
 from typing import Optional, Set, Tuple
 
-from ..io_types import IOReq, StoragePlugin
+from ..io_types import IOReq, StoragePlugin, emit_storage_op
 
 
 def _fsync_dir(path: str) -> None:
@@ -96,6 +96,57 @@ class FSStoragePlugin(StoragePlugin):
         # metadata referencing them.
         self._flush_dirty_dirs()
 
+    @staticmethod
+    def _writer_alive(pid_str: str) -> bool:
+        """Whether the process that named a ``.tmp<pid>`` file still
+        runs ON THIS HOST. EPERM means alive (another user's process);
+        an unparseable suffix reads as alive — fail toward keeping."""
+        if not (pid_str.isascii() and pid_str.isdigit()):
+            return True
+        try:
+            os.kill(int(pid_str), 0)
+        except ProcessLookupError:
+            return False
+        # EPERM (someone else's live process), OverflowError (a numeric
+        # suffix past C long — not a real pid), and friends: keep.
+        except Exception:  # snapcheck: disable=swallowed-exception -- fails toward keeping
+            return True
+        return True
+
+    @classmethod
+    def _clean_stale_tmp(cls, full: str, own_tmp: str) -> None:
+        """Remove torn ``<name>.tmp<pid>`` siblings a CRASHED process
+        left for the object about to be (re)written. Stale means the
+        writer pid is dead: a live concurrent writer's in-flight tmp
+        (e.g. an offline reconcile adopting the marker an async
+        finalize is writing right now) must survive, or its rename
+        fails with a non-retryable FileNotFoundError — before this
+        cleanup existed, concurrent same-path writers were safe under
+        last-rename-wins, and they must stay safe. Pid liveness is a
+        same-host test; a shared-fs writer from another host may look
+        dead — but then BOTH writers are re-driving the same recovery
+        path, and the survivor rewrites the object anyway. Only publish
+        points pay this (small directories, and they are the paths
+        re-driven after a crash — markers, tombstones, metadata);
+        payload debris in step directories is reclaimed by sweeps."""
+        d = os.path.dirname(full)
+        prefix = os.path.basename(full) + ".tmp"
+        own = os.path.basename(own_tmp)
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if (
+                name.startswith(prefix)
+                and name != own
+                and not cls._writer_alive(name[len(prefix):])
+            ):
+                try:
+                    os.remove(os.path.join(d, name))
+                except FileNotFoundError:
+                    pass  # concurrent cleanup won the race: already gone
+
     def _write_sync(self, io_req: IOReq) -> None:
         self._prepare_dir(io_req.path)
         full = os.path.join(self.root, io_req.path)
@@ -108,9 +159,18 @@ class FSStoragePlugin(StoragePlugin):
         # Write to a temp name then rename for per-object atomicity (the
         # reference has no partial-write protection; POSIX rename is free).
         tmp = f"{full}.tmp{os.getpid()}"
+        if publish:
+            self._clean_stale_tmp(full, tmp)
         payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
+        # Op-granular boundaries (faultline): a hook may raise here to
+        # model a crash BETWEEN the sub-steps of the durability protocol
+        # — after the tmp data landed but before it was fsynced, after
+        # the fsync but before the rename published it, and after the
+        # rename but before the dirent became durable.
+        emit_storage_op("fs.write.tmp", io_req.path)
         with open(tmp, "wb") as f:
             f.write(payload)
+            emit_storage_op("fs.write.fsync", io_req.path)
             # Data must be durable BEFORE the rename publishes the final
             # name (snapcheck durability-order): a crash shortly after an
             # un-fsynced rename can leave the published name pointing at
@@ -118,7 +178,9 @@ class FSStoragePlugin(StoragePlugin):
             # references.
             f.flush()
             os.fsync(f.fileno())
+        emit_storage_op("fs.write.rename", io_req.path)
         os.replace(tmp, full)
+        emit_storage_op("fs.write.dirsync", io_req.path)
         # The rename's dirent must be durable too — immediately for a
         # publish point (it IS the commit), deferred to the next publish
         # point for data objects (nothing references them until then, and

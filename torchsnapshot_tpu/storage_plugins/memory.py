@@ -33,28 +33,42 @@ def _mtimes_for(store: dict) -> Dict[str, float]:
 
 
 class MemoryStoragePlugin(StoragePlugin):
-    def __init__(self, store: Optional[Dict[str, bytes]] = None) -> None:
+    def __init__(
+        self,
+        store: Optional[Dict[str, bytes]] = None,
+        prefix: str = "",
+    ) -> None:
         # A shared dict may be passed in so multiple plugin instances
-        # (e.g. simulated ranks) see one "bucket".
+        # (e.g. simulated ranks) see one "bucket". ``prefix`` makes the
+        # bucket hierarchical, like a real object store (bucket + key
+        # prefix): ``memory://run/step-0`` and ``memory://run`` share the
+        # "run" bucket, so listing the base prefix SEES the step's
+        # objects — the property CheckpointManager.reconcile() and the
+        # crash-consistency harness rely on (fs and cloud backends have
+        # it natively).
         self.store: Dict[str, bytes] = store if store is not None else {}
+        self.prefix = f"{prefix.rstrip('/')}/" if prefix else ""
         # mtimes are keyed off the SHARED store object, not per-instance:
         # sweep resolves a fresh plugin instance for the same bucket, and
         # a per-instance dict would make its age guard a silent no-op.
         self._mtimes = _mtimes_for(self.store)
         self._lock = asyncio.Lock()
 
+    def _key(self, path: str) -> str:
+        return self.prefix + path
+
     async def write(self, io_req: IOReq) -> None:
         import time
 
         payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
         async with self._lock:
-            self.store[io_req.path] = bytes(payload)
-            self._mtimes[io_req.path] = time.time()
+            self.store[self._key(io_req.path)] = bytes(payload)
+            self._mtimes[self._key(io_req.path)] = time.time()
 
     async def read(self, io_req: IOReq) -> None:
         async with self._lock:
             try:
-                data = self.store[io_req.path]
+                data = self.store[self._key(io_req.path)]
             except KeyError:
                 # Speak the same not-found dialect as the fs plugin so the
                 # not-found classifier needs no backend-specific cases.
@@ -66,25 +80,31 @@ class MemoryStoragePlugin(StoragePlugin):
 
     async def delete(self, path: str) -> None:
         async with self._lock:
-            if path not in self.store:
+            key = self._key(path)
+            if key not in self.store:
                 raise FileNotFoundError(path)
-            del self.store[path]
-            self._mtimes.pop(path, None)
+            del self.store[key]
+            self._mtimes.pop(key, None)
 
     async def list_prefix(self, prefix: str):
+        full = self._key(prefix)
         async with self._lock:
-            return [k for k in self.store if k.startswith(prefix)]
+            return [
+                k[len(self.prefix):]
+                for k in self.store
+                if k.startswith(full)
+            ]
 
     async def object_age_s(self, path: str):
         import time
 
         async with self._lock:
-            mtime = self._mtimes.get(path)
+            mtime = self._mtimes.get(self._key(path))
         return None if mtime is None else max(0.0, time.time() - mtime)
 
     async def object_size_bytes(self, path: str):
         async with self._lock:
-            data = self.store.get(path)
+            data = self.store.get(self._key(path))
         return None if data is None else len(data)
 
     def close(self) -> None:
