@@ -1,0 +1,169 @@
+"""Golden-output coverage for the inspect CLI (previously untested):
+default, --rank, --raw, and --report paths over a small memory://
+snapshot (ISSUE 3 satellite)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict, telemetry
+from torchsnapshot_tpu.inspect import main as inspect_main
+from torchsnapshot_tpu.storage_plugin import _MEMORY_STORES
+from torchsnapshot_tpu.utils.test_utils import run_thread_ranks
+
+
+class _Model:
+    def __init__(self, params):
+        self.params = params
+
+    def state_dict(self):
+        return self.params
+
+    def load_state_dict(self, sd):
+        self.params = sd
+
+
+def _golden_state():
+    return _Model(
+        {
+            "w": jnp.asarray(np.arange(48, dtype=np.float32).reshape(8, 6)),
+            "b": jnp.zeros(6, jnp.float32),
+            "meta": {"name": "golden"},
+        }
+    )
+
+
+@pytest.fixture()
+def golden_snapshot():
+    bucket = "inspect-golden"
+    _MEMORY_STORES.pop(bucket, None)
+    url = f"memory://{bucket}/snap"
+    Snapshot.take(
+        url,
+        {"model": _golden_state(), "progress": StateDict(step=7, done=False)},
+    )
+    return url
+
+
+GOLDEN_DEFAULT = """\
+model                                                        <dict>
+model/b                                                      Array float32(6,) 24B @ 0/model/b
+model/meta                                                   <dict>
+model/meta/name                                              str = 'golden'
+model/w                                                      Array float32(8, 6) 192B @ 0/model/w
+progress                                                     <dict>
+progress/done                                                bool = False
+progress/step                                                int = 7
+
+8 entries, 216B of array data
+"""
+
+GOLDEN_RAW = """\
+0/model                                                      <dict>
+0/model/b                                                    Array float32(6,) 24B @ 0/model/b
+0/model/meta                                                 <dict>
+0/model/meta/name                                            str = 'golden'
+0/model/w                                                    Array float32(8, 6) 192B @ 0/model/w
+0/progress                                                   <dict>
+0/progress/done                                              bool = False
+0/progress/step                                              int = 7
+
+8 entries, 216B of array data
+"""
+
+
+def test_default_listing_golden(golden_snapshot, capsys):
+    assert inspect_main([golden_snapshot]) == 0
+    assert capsys.readouterr().out == GOLDEN_DEFAULT
+
+
+def test_raw_listing_golden(golden_snapshot, capsys):
+    assert inspect_main([golden_snapshot, "--raw"]) == 0
+    assert capsys.readouterr().out == GOLDEN_RAW
+
+
+def test_rank_selects_per_rank_view(capsys):
+    """--rank N shows rank N's values; a 2-rank snapshot's ranks differ."""
+    bucket = "inspect-ranks"
+    _MEMORY_STORES.pop(bucket, None)
+    url = f"memory://{bucket}/snap"
+
+    def fn(coord, rank):
+        model = _Model(
+            {"w": np.full(4 + rank, float(rank), dtype=np.float32)}
+        )
+        Snapshot.take(url, {"model": model}, coord=coord)
+
+    run_thread_ranks(2, fn)
+    assert inspect_main([url, "--rank", "0"]) == 0
+    rank0 = capsys.readouterr().out
+    assert inspect_main([url, "--rank", "1"]) == 0
+    rank1 = capsys.readouterr().out
+    assert "float32(4,)" in rank0 and "@ 0/model/w" in rank0
+    assert "float32(5,)" in rank1 and "@ 1/model/w" in rank1
+    assert rank0 != rank1
+
+
+def test_report_golden(golden_snapshot, capsys):
+    assert inspect_main([golden_snapshot, "--report"]) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[0].startswith(f"take report for {golden_snapshot}")
+    assert "(take_id " in lines[0]
+    assert lines[1].startswith("world 1: 216 bytes in ")
+    assert "| retries 0 | faults 0 | budget stall" in lines[1]
+    assert lines[2].split() == [
+        "rank", "bytes", "MB/s", "stall_s", "retries", "phases",
+    ]
+    assert lines[3].split()[0] == "0"
+    assert lines[3].split()[1] == "216"
+    assert "capture=" in lines[3] and "write=" in lines[3]
+    assert "commit=" in lines[3]
+    assert "stage[n=" in lines[4] and "write[n=" in lines[4]
+
+
+def test_report_includes_restore_records(golden_snapshot, capsys):
+    Snapshot(golden_snapshot).restore(
+        {
+            "model": _Model(
+                {
+                    "w": jnp.zeros((8, 6), jnp.float32),
+                    "b": jnp.ones(6, jnp.float32),
+                    "meta": {"name": ""},
+                }
+            ),
+            "progress": StateDict(step=0, done=True),
+        }
+    )
+    assert inspect_main([golden_snapshot, "--report"]) == 0
+    out = capsys.readouterr().out
+    assert "take report for" in out
+    assert "restore report for" in out
+    assert "read=" in out and "consume=" in out and "assemble=" in out
+
+
+def test_report_on_nonexistent_snapshot_says_so(tmp_path, capsys):
+    """A typo'd path reads as "no snapshot", never as "no telemetry"."""
+    assert inspect_main([str(tmp_path / "nope"), "--report"]) == 1
+    err = capsys.readouterr().err
+    assert "no snapshot at" in err
+    assert "flight record" not in err
+
+
+def test_report_missing_exits_1(tmp_path, capsys):
+    """A snapshot whose report was removed (or predates telemetry)
+    exits 1 with a pointer, not a traceback."""
+    model = _Model({"w": np.arange(8, dtype=np.float32)})
+    snap_dir = tmp_path / "snap"
+    Snapshot.take(str(snap_dir), {"model": model})
+    (snap_dir / ".report.json").unlink()
+    assert inspect_main([str(snap_dir), "--report"]) == 1
+    assert "no flight record" in capsys.readouterr().err
+
+
+def test_report_is_exclusive_with_verify(golden_snapshot, capsys):
+    with pytest.raises(SystemExit):
+        inspect_main([golden_snapshot, "--report", "--verify"])

@@ -1,0 +1,336 @@
+"""snapstats: metrics registry, exporters, tracing crash-safety, and the
+faultline→telemetry bridge (ISSUE 3)."""
+
+import asyncio
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict, telemetry, tracing
+from torchsnapshot_tpu.telemetry import export as tele_export
+from torchsnapshot_tpu.telemetry import metrics as tm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class _Model:
+    def __init__(self, params):
+        self.params = params
+
+    def state_dict(self):
+        return self.params
+
+    def load_state_dict(self, sd):
+        self.params = sd
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_gauge_histogram_basics():
+    c = telemetry.counter("t_total", op="write")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = telemetry.gauge("t_gauge")
+    g.set(7)
+    g.set_max(3)  # lower: no-op
+    assert g.value == 7
+    g.set_max(11)
+    assert g.value == 11
+
+    h = telemetry.histogram("t_hist")
+    for v in (0.3, 0.6, 1.0, 100.0):
+        h.observe(v)
+    data = h.collect()
+    assert data["count"] == 4
+    assert data["sum"] == pytest.approx(101.9)
+    # log2 buckets: 0.3→0.5, 0.6→1, 1.0→1, 100→128
+    assert data["buckets"] == {"0.5": 1, "1": 2, "128": 1}
+
+
+def test_bucket_le_edges():
+    assert tm.bucket_le(0) == 0.0
+    assert tm.bucket_le(-3) == 0.0
+    assert tm.bucket_le(1.0) == 1.0  # exact power stays in its own bucket
+    assert tm.bucket_le(2.0) == 2.0
+    assert tm.bucket_le(2.1) == 4.0
+    assert tm.bucket_le(0.25) == 0.25
+
+
+def test_same_labels_same_metric_instance():
+    assert telemetry.counter("t_c", a="1", b="2") is telemetry.counter(
+        "t_c", b="2", a="1"
+    )
+    assert telemetry.counter("t_c") is not telemetry.counter("t_c", a="1")
+
+
+def test_name_bound_to_one_kind():
+    telemetry.counter("t_kind")
+    with pytest.raises(ValueError, match="already registered"):
+        telemetry.gauge("t_kind")
+
+
+def test_snapshot_and_diff():
+    telemetry.counter("t_n", op="w").inc(3)
+    before = telemetry.snapshot()
+    assert before['t_n{op="w"}'] == 3
+    telemetry.counter("t_n", op="w").inc(2)
+    telemetry.histogram("t_h").observe(1.5)
+    delta = telemetry.diff_snapshots(before, telemetry.snapshot())
+    assert delta['t_n{op="w"}'] == 2
+    assert delta["t_h"]["count"] == 1
+    # zero-delta samples are dropped
+    telemetry.counter("t_quiet").inc(1)
+    before2 = telemetry.snapshot()
+    assert "t_quiet" not in telemetry.diff_snapshots(
+        before2, telemetry.snapshot()
+    )
+
+
+def test_counter_thread_safety():
+    c = telemetry.counter("t_race")
+
+    def worker():
+        for _ in range(10_000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80_000
+
+
+# ----------------------------------------------------------------- exporters
+
+
+def test_prometheus_textfile_round_trip(tmp_path):
+    telemetry.counter("t_ops_total", op="write").inc(5)
+    telemetry.gauge("t_hwm", pipeline="read").set(1024)
+    h = telemetry.histogram("t_lat_seconds", op="read")
+    for v in (0.001, 0.002, 0.5, 3.0):
+        h.observe(v)
+    path = str(tmp_path / "metrics.prom")
+    tele_export.write_textfile(path)
+    with open(path) as f:
+        doc = f.read()
+    parsed = tele_export.parse_textfile(doc)
+    assert parsed["t_ops_total"]["type"] == "counter"
+    assert parsed["t_ops_total"]["samples"]['t_ops_total{op="write"}'] == 5
+    assert parsed["t_hwm"]["samples"]['t_hwm{pipeline="read"}'] == 1024
+    hist = parsed["t_lat_seconds"]["samples"]
+    assert hist['t_lat_seconds_count{op="read"}'] == 4
+    assert hist['t_lat_seconds_sum{op="read"}'] == pytest.approx(3.503)
+    # +Inf bucket present and equal to count (validated by the parser,
+    # asserted here too so a parser regression cannot mask it)
+    assert hist['t_lat_seconds_bucket{le="+Inf",op="read"}'] == 4
+    # no tmp debris from the atomic write
+    assert [p for p in os.listdir(tmp_path) if ".tmp" in p] == []
+
+
+def test_textfile_parser_rejects_garbage():
+    with pytest.raises(ValueError, match="malformed sample"):
+        tele_export.parse_textfile("this is { not a metric\n")
+    with pytest.raises(ValueError, match="malformed labels"):
+        tele_export.parse_textfile('m{op=unquoted} 1\n')
+    with pytest.raises(ValueError, match=r"\+Inf"):
+        tele_export.parse_textfile(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 2\n'
+            "h_count 2\n"
+        )
+
+
+def test_label_value_escaping_round_trips():
+    telemetry.counter("t_esc", detail='quote"back\\slash').inc()
+    parsed = tele_export.parse_textfile(tele_export.render_textfile())
+    (key,) = parsed["t_esc"]["samples"]
+    assert 'quote' in key
+    assert parsed["t_esc"]["samples"][key] == 1
+
+
+def test_jsonl_append(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tele_export.append_jsonl(path, {"a": 1})
+    tele_export.append_jsonl(path, {"b": 2})
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines == [{"a": 1}, {"b": 2}]
+
+
+def test_env_auto_export(tmp_path, monkeypatch):
+    """A take with the env knobs set rewrites the textfile and appends a
+    flight summary line — the always-on exporter wiring. The textfile
+    lands at a per-process (.pid<N>) path so ranks sharing the env var
+    cannot clobber each other's exposition."""
+    prom = str(tmp_path / "m.prom")
+    jsonl = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv(tele_export.TEXTFILE_ENV_VAR, prom)
+    monkeypatch.setenv(tele_export.JSONL_ENV_VAR, jsonl)
+    model = _Model({"w": jnp.arange(64, dtype=jnp.float32)})
+    Snapshot.take(str(tmp_path / "snap"), {"model": model})
+    prom_actual = str(tmp_path / f"m.pid{os.getpid()}.prom")
+    parsed = tele_export.parse_textfile(open(prom_actual).read())
+    assert 'tpusnapshot_takes_total{mode="sync"}' in (
+        parsed[tm.TAKES_TOTAL]["samples"]
+    )
+    with open(jsonl) as f:
+        (record,) = [json.loads(line) for line in f]
+    assert record["kind"] == "take"
+    assert record["bytes"] == 64 * 4
+
+
+# ---------------------------------------------------------- scheduler metrics
+
+
+def test_take_records_scheduler_and_storage_metrics(tmp_path):
+    model = _Model({"w": np.arange(2048, dtype=np.float32)})
+    Snapshot.take("memory://telemetry-sched/snap", {"model": model})
+    snap = telemetry.snapshot()
+    assert snap['tpusnapshot_scheduler_op_seconds{op="stage"}']["count"] == 1
+    assert snap['tpusnapshot_scheduler_op_bytes{op="write"}']["sum"] == 8192
+    # storage-op histograms observed the payload write AND the metadata
+    writes = snap['tpusnapshot_storage_op_seconds{backend="memory",op="write"}']
+    assert writes["count"] >= 2
+    assert snap['tpusnapshot_takes_total{mode="sync"}'] == 1
+
+
+# ----------------------------------------------------- tracing crash-safety
+
+
+def test_flush_is_atomic_and_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tracing.enable(path)
+    try:
+        with tracing.span("x"):
+            pass
+        out = tracing.flush()
+    finally:
+        tracing.disable()
+    assert out == path
+    assert [p for p in os.listdir(tmp_path) if ".tmp" in p] == []
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    assert {e["ph"] for e in events} == {"b", "e"}
+
+
+def test_disable_flushes_pending_spans(tmp_path):
+    """enable → span → disable (no explicit flush) must not drop spans."""
+    path = str(tmp_path / "trace.json")
+    tracing.enable(path)
+    with tracing.span("kept"):
+        pass
+    tracing.disable()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    assert [e["name"] for e in events] == ["kept", "kept"]
+    assert not tracing.enabled()
+
+
+def test_flush_overwrites_previous_complete_trace(tmp_path):
+    """A reader between flushes always sees a complete document."""
+    path = str(tmp_path / "trace.json")
+    tracing.enable(path)
+    try:
+        with tracing.span("a"):
+            pass
+        tracing.flush()
+        first = json.load(open(path))
+        with tracing.span("b"):
+            pass
+        tracing.flush()
+        second = json.load(open(path))
+    finally:
+        tracing.disable()
+    assert len(first["traceEvents"]) == 2
+    assert len(second["traceEvents"]) == 4
+
+
+# ------------------------------------------------- faultline/telemetry bridge
+
+
+def test_fault_and_retry_instants_match_counters(tmp_path, monkeypatch):
+    """Every fault_injected / storage_retry trace instant has a matching
+    always-on counter increment: instant-count == counter-count under a
+    scripted FaultSchedule."""
+    from torchsnapshot_tpu.faultline import FaultSchedule, inject
+
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "4")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.io_types._RETRY_BACKOFF_INITIAL_S", 0.001
+    )
+    trace_path = str(tmp_path / "trace.json")
+    tracing.enable(trace_path)
+    try:
+        schedule = (
+            FaultSchedule()
+            .transient(op="write", path="0/model/*", nth=1, times=2)
+            .transient(op="write", path=".snapshot_metadata", times=1)
+            .latency(op="read", seconds=0.0, times=1)
+        )
+        with inject(schedule) as ctl:
+            model = _Model({"w": np.arange(256, dtype=np.float32)})
+            snap = Snapshot.take(str(tmp_path / "snap"), {"model": model})
+            fresh = _Model({"w": np.zeros(256, dtype=np.float32)})
+            snap.restore({"model": fresh})
+        tracing.flush()
+    finally:
+        tracing.disable()
+    with open(trace_path) as f:
+        events = json.load(f)["traceEvents"]
+    fault_instants = [
+        e for e in events if e["ph"] == "i" and e["name"] == "fault_injected"
+    ]
+    retry_instants = [
+        e for e in events if e["ph"] == "i" and e["name"] == "storage_retry"
+    ]
+    snap_metrics = telemetry.snapshot()
+    fault_count = tm.sum_samples(snap_metrics, tm.FAULTS_INJECTED)
+    retry_count = tm.sum_samples(snap_metrics, tm.STORAGE_RETRIES)
+    assert len(fault_instants) == fault_count == len(ctl.records)
+    assert len(retry_instants) == retry_count
+    assert retry_count >= 3  # the three injected transients were retried
+    # backoff seconds accumulated alongside
+    assert tm.sum_samples(snap_metrics, tm.STORAGE_RETRY_BACKOFF) > 0
+    # and the fault-kind breakdown matches the controller's log
+    by_kind = tm.samples_by_label(snap_metrics, tm.FAULTS_INJECTED, "kind")
+    assert by_kind.get("transient") == 3
+    assert by_kind.get("latency") == 1
+
+
+# ------------------------------------------------------------- coord metrics
+
+
+def test_coord_collectives_record_wait_histograms():
+    from torchsnapshot_tpu.utils.test_utils import run_thread_ranks
+
+    def fn(coord, rank):
+        coord.barrier()
+        coord.all_gather_object(rank)
+        coord.broadcast_object(rank if rank == 0 else None, src=0)
+
+    run_thread_ranks(2, fn)
+    snap = telemetry.snapshot()
+    assert snap['tpusnapshot_coord_wait_seconds{op="barrier"}']["count"] == 2
+    assert (
+        snap['tpusnapshot_coord_wait_seconds{op="all_gather"}']["count"] == 2
+    )
+    # only receivers time the broadcast wait (the source publishes)
+    assert (
+        snap['tpusnapshot_coord_wait_seconds{op="broadcast"}']["count"] == 1
+    )

@@ -6,6 +6,7 @@ plus the async-take handle ``PendingSnapshot`` and the ``Coordinator``
 shim for explicit multi-process control.
 """
 
+from . import telemetry
 from .coord import (
     Coordinator,
     DictStore,
@@ -39,5 +40,6 @@ __all__ = [
     "Stateful",
     "StoreCoordinator",
     "get_coordinator",
+    "telemetry",
     "__version__",
 ]

@@ -34,11 +34,22 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .core import Diagnostic, Rule, dotted_name
 
+# Entries are file basenames, or slashed suffixes ("telemetry/metrics.py")
+# for generically-named files that must only match inside their package —
+# a bare "metrics.py" would drag every fixture or example of that name
+# into the concurrency lint.
 _DEFAULT_MODULES = (
     "scheduler.py",
     "coord.py",
     "manager.py",
     "tracing.py",
+    # snapstats: the metrics registry is mutated from the event loop,
+    # executor threads, and async-take drains at once; the flight
+    # recorder's phase map is written from the background drain while
+    # the foreground reads summaries. Analyzed, not skipped.
+    "telemetry/metrics.py",
+    "telemetry/report.py",
+    "telemetry/export.py",
 )
 
 _LOCK_FACTORIES = {
@@ -185,7 +196,14 @@ class LocksetRule(Rule):
         self._modules = modules
 
     def applies_to(self, path: str) -> bool:
-        return os.path.basename(path) in self._modules
+        norm = path.replace(os.sep, "/")
+        for module in self._modules:
+            if "/" in module:
+                if norm == module or norm.endswith("/" + module):
+                    return True
+            elif os.path.basename(path) == module:
+                return True
+        return False
 
     def check(
         self, tree: ast.AST, lines: Sequence[str], path: str

@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import telemetry
+
 logger = logging.getLogger(__name__)
 
 _DEFAULT_TIMEOUT_S = 300.0
@@ -447,17 +449,25 @@ class StoreCoordinator(Coordinator):
         # every rank that never arrives is named in the error instead of
         # surfacing as an opaque store-key timeout.
         deadline = time.monotonic() + wait
-        for r in range(self._world):
-            try:
-                self._store.get(f"b/{gen}/{r}", self._remaining(deadline))
-            except TimeoutError:
-                missing = self._absent_ranks(f"b/{gen}/{{rank}}", r)
-                raise TimeoutError(
-                    f"barrier (generation {gen}) timed out after "
-                    f"{wait:g}s: {self._fmt_ranks(missing)} never arrived "
-                    f"(observed by rank {self._rank} of {self._world}); "
-                    f"likely crashed or stuck in storage IO."
-                ) from None
+        wait_t0 = time.monotonic()
+        try:
+            for r in range(self._world):
+                try:
+                    self._store.get(f"b/{gen}/{r}", self._remaining(deadline))
+                except TimeoutError:
+                    missing = self._absent_ranks(f"b/{gen}/{{rank}}", r)
+                    raise TimeoutError(
+                        f"barrier (generation {gen}) timed out after "
+                        f"{wait:g}s: {self._fmt_ranks(missing)} never arrived "
+                        f"(observed by rank {self._rank} of {self._world}); "
+                        f"likely crashed or stuck in storage IO."
+                    ) from None
+        finally:
+            # Timed-out barriers observe too: a stall that ends in an
+            # error is exactly the wait a dashboard must show.
+            telemetry.record_coord_wait(
+                "barrier", time.monotonic() - wait_t0
+            )
         self._gc_through(gen)
 
     def all_gather_object(self, obj: Any) -> List[Any]:
@@ -471,22 +481,28 @@ class StoreCoordinator(Coordinator):
         # timeout.
         deadline = time.monotonic() + self._timeout_s
         out = []
-        for r in range(self._world):
-            try:
-                out.append(
-                    pickle.loads(
-                        self._get_chunked(f"ag/{gen}/{r}", deadline)
+        wait_t0 = time.monotonic()
+        try:
+            for r in range(self._world):
+                try:
+                    out.append(
+                        pickle.loads(
+                            self._get_chunked(f"ag/{gen}/{r}", deadline)
+                        )
                     )
-                )
-            except TimeoutError:
-                missing = self._absent_ranks(f"ag/{gen}/{{rank}}", r)
-                raise TimeoutError(
-                    f"all_gather (generation {gen}) timed out after "
-                    f"{self._timeout_s:g}s total: "
-                    f"{self._fmt_ranks(missing)} never "
-                    f"finished publishing (observed by rank "
-                    f"{self._rank} of {self._world})."
-                ) from None
+                except TimeoutError:
+                    missing = self._absent_ranks(f"ag/{gen}/{{rank}}", r)
+                    raise TimeoutError(
+                        f"all_gather (generation {gen}) timed out after "
+                        f"{self._timeout_s:g}s total: "
+                        f"{self._fmt_ranks(missing)} never "
+                        f"finished publishing (observed by rank "
+                        f"{self._rank} of {self._world})."
+                    ) from None
+        finally:
+            telemetry.record_coord_wait(
+                "all_gather", time.monotonic() - wait_t0
+            )
         self._gc_through(gen)
         return out
 
@@ -506,6 +522,7 @@ class StoreCoordinator(Coordinator):
             return obj
         self._prune_consumed_acks()
         deadline = time.monotonic() + self._timeout_s
+        wait_t0 = time.monotonic()
         try:
             out = pickle.loads(self._get_chunked(f"bc/{gen}", deadline))
         except TimeoutError:
@@ -515,6 +532,10 @@ class StoreCoordinator(Coordinator):
                 f"finished publishing (receiving rank {self._rank} of "
                 f"{self._world})."
             ) from None
+        finally:
+            telemetry.record_coord_wait(
+                "broadcast", time.monotonic() - wait_t0
+            )
         # Ack after the read completes: the source may delete the payload
         # keys the moment all acks exist. The ack is also tracked in
         # _own_keys so barrier/gather progress collects it if the source

@@ -36,7 +36,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
-from .. import tracing
+from .. import telemetry, tracing
+from ..telemetry import metrics as _metric_names
 
 
 class SimulatedCrash(BaseException):
@@ -257,8 +258,12 @@ class FaultController:
     def _record(self, idx: int, op: str, path: str, kind: str) -> None:
         # Lock held by caller. The trace event satisfies "traces show
         # recovery behavior": every injected fault is visible next to the
-        # storage_retry instants the retry layer emits.
+        # storage_retry instants the retry layer emits. The matching
+        # always-on counter rides beside it — one increment per instant,
+        # so trace instant-count == counter-count by construction
+        # (tests/test_telemetry.py pins the equality).
         self.records.append(FaultRecord(idx, op, path, kind))
+        telemetry.counter(_metric_names.FAULTS_INJECTED, kind=kind).inc()
         tracing.instant(
             "fault_injected", op=op, path=path, kind=kind, op_index=idx
         )

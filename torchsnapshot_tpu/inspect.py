@@ -57,6 +57,63 @@ def _describe(path: str, entry) -> str:
     return f"{path:60s} {entry.type}"
 
 
+def _print_reports(path: str) -> int:
+    """Render the snapshot's flight record(s): the committed take report
+    plus any rank-local restore reports present."""
+    import asyncio
+
+    from .storage_plugin import url_to_storage_plugin
+    from .telemetry import report as flight
+
+    from .io_types import IOReq, is_not_found_error
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+
+    storage = url_to_storage_plugin(path)
+    try:
+        # A typo'd path must read as "no snapshot here", not as "this
+        # snapshot predates telemetry" — the two send an operator down
+        # entirely different debugging paths.
+        try:
+            asyncio.run(storage.read(IOReq(path=SNAPSHOT_METADATA_FNAME)))
+        except Exception as e:
+            if is_not_found_error(e):
+                print(f"no snapshot at {path}", file=sys.stderr)
+                return 1
+            raise
+        take_report = asyncio.run(
+            flight.aread_json(storage, flight.REPORT_FNAME)
+        )
+        restore_paths = sorted(
+            p
+            for p in (
+                asyncio.run(storage.list_prefix(flight.REPORT_PREFIX)) or []
+            )
+            if p.startswith(".report.restore.")
+        )
+        printed = False
+        if take_report is not None:
+            print(flight.render_report(take_report))
+            printed = True
+        for rp in restore_paths:
+            doc = asyncio.run(flight.aread_json(storage, rp))
+            if doc is None:
+                continue
+            if printed:
+                print()
+            print(flight.render_report(doc))
+            printed = True
+        if not printed:
+            print(
+                f"no flight record at {path} (snapshot taken before "
+                f"telemetry existed, or its report write failed)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    finally:
+        storage.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="torchsnapshot_tpu.inspect")
     parser.add_argument("path")
@@ -107,6 +164,15 @@ def main(argv=None) -> int:
         "transit; the destination commits metadata-last",
     )
     parser.add_argument(
+        "--report",
+        action="store_true",
+        help="print the snapshot's embedded flight record (.report.json: "
+        "per-rank phase timings, bytes, throughput, budget stall, "
+        "retry/fault counts) plus any restore reports found; exit 1 "
+        "when the snapshot has no report (taken before telemetry, or "
+        "the report write failed)",
+    )
+    parser.add_argument(
         "--diff",
         metavar="OLDER",
         help="content-diff PATH against the OLDER snapshot: which "
@@ -126,13 +192,16 @@ def main(argv=None) -> int:
         bool(args.reconcile),
         bool(args.copy_to),
         bool(args.diff),
+        bool(args.report),
     ]
     if sum(exclusive) > 1:
         parser.error(
             "--verify, --delete/--sweep, --convert-back, --steps, "
-            "--reconcile, --copy-to, and --diff are mutually exclusive; "
-            "run them in separate invocations"
+            "--reconcile, --copy-to, --diff, and --report are mutually "
+            "exclusive; run them in separate invocations"
         )
+    if args.report:
+        return _print_reports(args.path)
     if args.diff:
         result = Snapshot(args.path).diff(args.diff, rank=args.rank)
         for kind in ("added", "removed", "changed", "unknown"):

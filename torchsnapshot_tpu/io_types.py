@@ -24,7 +24,8 @@ from concurrent.futures import Executor
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Union
 
-from . import tracing
+from . import telemetry, tracing
+from .telemetry import metrics as _metric_names
 
 BufferType = Union[bytes, bytearray, memoryview]
 
@@ -241,6 +242,18 @@ async def retry_storage_op(make_coro, desc: str):
                     f"({elapsed:.1f}s elapsed of {budget_s:g}s) — giving up"
                 )
                 raise
+            # Always-on retry accounting next to the (tracing-gated)
+            # instant, so instant-count == counter-count whenever a
+            # trace is being recorded (tests/test_telemetry.py pins
+            # this). The op *type* labels the counter — the full desc
+            # carries a path, and paths are unbounded-cardinality.
+            op_type = desc.split("(", 1)[0]
+            telemetry.counter(
+                _metric_names.STORAGE_RETRIES, op=op_type
+            ).inc()
+            telemetry.counter(
+                _metric_names.STORAGE_RETRY_BACKOFF, op=op_type
+            ).inc(delay)
             tracing.instant(
                 "storage_retry",
                 op=desc,

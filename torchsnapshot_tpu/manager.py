@@ -31,9 +31,11 @@ keeps the sub-second stall of ``Snapshot.async_take``.
 import asyncio
 import logging
 import os
+import time
 from typing import Any, List, Optional
 
-from . import tracing
+from . import telemetry, tracing
+from .telemetry import metrics as _metric_names
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .io_types import IOReq, is_not_found_error
 from .snapshot import (
@@ -480,7 +482,11 @@ class CheckpointManager:
                     marker.buf.write(
                         _step_dir(self.base_path, step).encode()
                     )
+                    marker_t0 = time.monotonic()
                     asyncio.run(storage.write(marker))
+                    telemetry.histogram(
+                        _metric_names.MANAGER_STEP_MARKER_SECONDS
+                    ).observe(time.monotonic() - marker_t0)
                     # Manager-level commit milestone (the snapshot-level
                     # one is metadata_committed): from here the step is
                     # resolvable and must restore clean under any crash.
@@ -498,6 +504,15 @@ class CheckpointManager:
                 storage.close()
 
     def _prune(self, storage: Any) -> None:
+        prune_t0 = time.monotonic()
+        try:
+            self._prune_impl(storage)
+        finally:
+            telemetry.histogram(
+                _metric_names.MANAGER_PRUNE_SECONDS
+            ).observe(time.monotonic() - prune_t0)
+
+    def _prune_impl(self, storage: Any) -> None:
         # Two-phase with a tombstone, so an interrupted prune is
         # re-driven by the NEXT prune instead of leaking the step's
         # payloads forever (markers alone cannot re-find a step whose
@@ -559,6 +574,7 @@ class CheckpointManager:
                     if not is_not_found_error(e):
                         raise
                 Snapshot(_step_dir(self.base_path, step)).delete(sweep=True)
+                telemetry.counter(_metric_names.MANAGER_STEPS_PRUNED).inc()
                 # The tombstone clears only once the step prefix is
                 # verifiably empty: a retry sweep may SPARE young
                 # unreferenced payloads under TPUSNAPSHOT_SWEEP_MIN_AGE_S

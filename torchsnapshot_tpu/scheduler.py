@@ -35,12 +35,13 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import tracing
+from . import telemetry, tracing
 from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
+from .telemetry import metrics as _metric_names
 
 logger = logging.getLogger(__name__)
 
@@ -69,7 +70,7 @@ def get_process_memory_budget_bytes(coord) -> int:
     env_val = os.environ.get(_MEMORY_BUDGET_ENV_VAR)
     if env_val is not None:
         budget = int(env_val)
-        logger.info(f"Memory budget overridden by env var: {budget} bytes")
+        logger.info("Memory budget overridden by env var: %d bytes", budget)
         return budget
     local_world_size = get_local_world_size(coord)
     return _memory_budget_for_local_world(local_world_size)
@@ -90,8 +91,53 @@ def _memory_budget_for_local_world(local_world_size: int) -> int:
         int(available * _AVAILABLE_MEMORY_MULTIPLIER) // local_world_size,
         _MAX_PER_RANK_MEMORY_BUDGET_BYTES,
     )
-    logger.info(f"Per-process memory budget: {budget // 1024 // 1024} MB")
+    logger.info("Per-process memory budget: %d MB", budget // 1024 // 1024)
     return budget
+
+
+def _observe_op(
+    ops: Dict[str, Dict[str, Any]], op: str, seconds: float, nbytes: int
+) -> None:
+    """Record one pipelined op in the always-on metrics AND the per-call
+    aggregate (the flight recorder's exact per-operation numbers). Only
+    ever called from the event-loop thread, so the plain dict is safe."""
+    telemetry.record_scheduler_op(op, seconds, nbytes)
+    agg = ops.setdefault(op, {"count": 0, "seconds": 0.0, "bytes": 0})
+    agg["count"] += 1
+    agg["seconds"] += seconds
+    agg["bytes"] += nbytes
+
+
+def _merge_stats(
+    stats: Optional[Dict[str, Any]],
+    pipeline: str,
+    nbytes: int,
+    stall_s: float,
+    high_water: int,
+    ops: Dict[str, Dict[str, Any]],
+) -> None:
+    """Fold one pipeline run's aggregates into the always-on metrics and
+    (when the caller wants per-operation attribution) the ``stats``
+    accumulator dict."""
+    telemetry.counter(
+        _metric_names.SCHED_STALL_SECONDS, pipeline=pipeline
+    ).inc(stall_s)
+    telemetry.gauge(
+        _metric_names.SCHED_BUDGET_HWM, pipeline=pipeline
+    ).set_max(high_water)
+    if stats is None:
+        return
+    stats["bytes"] = stats.get("bytes", 0) + nbytes
+    stats["stall_s"] = stats.get("stall_s", 0.0) + stall_s
+    stats["budget_high_water_bytes"] = max(
+        stats.get("budget_high_water_bytes", 0), high_water
+    )
+    out = stats.setdefault("ops", {})
+    for op, agg in ops.items():
+        acc = out.setdefault(op, {"count": 0, "seconds": 0.0, "bytes": 0})
+        acc["count"] += agg["count"]
+        acc["seconds"] += agg["seconds"]
+        acc["bytes"] += agg["bytes"]
 
 
 async def execute_write_reqs(
@@ -99,14 +145,24 @@ async def execute_write_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> int:
-    """Run the staged-write pipeline; returns total bytes written."""
+    """Run the staged-write pipeline; returns total bytes written.
+
+    ``stats`` (optional) accumulates this run's exact aggregates —
+    bytes, per-op count/seconds/bytes, budget stall seconds, budget
+    high-water — for the flight recorder; the same numbers also feed the
+    always-on process metrics.
+    """
     begin_ts = time.monotonic()
     pending = deque(write_reqs)
     staged: deque = deque()  # (WriteReq, buf)
     staging: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
     io_tasks: Dict[asyncio.Task, int] = {}
     budget = memory_budget_bytes
+    min_budget = memory_budget_bytes
+    stall_s = 0.0
+    ops: Dict[str, Dict[str, Any]] = {}
     bytes_written = 0
     max_io = storage.max_write_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
@@ -114,20 +170,28 @@ async def execute_write_reqs(
         while pending or staged or staging or io_tasks:
             # Dispatch staging while the budget allows; always keep at
             # least one request moving.
+            budget_blocked = False
             while pending:
                 cost = pending[0].buffer_stager.get_staging_cost_bytes()
                 nothing_in_flight = not (staging or staged or io_tasks)
                 if budget >= cost or nothing_in_flight:
                     wr = pending.popleft()
                     budget -= cost
+                    min_budget = min(min_budget, budget)
 
                     async def _stage(wr=wr, cost=cost):
+                        t0 = time.monotonic()
                         with tracing.span("stage", path=wr.path, bytes=cost):
-                            return await wr.buffer_stager.stage_buffer(executor)
+                            buf = await wr.buffer_stager.stage_buffer(executor)
+                        _observe_op(
+                            ops, "stage", time.monotonic() - t0, len(buf)
+                        )
+                        return buf
 
                     task = asyncio.ensure_future(_stage())
                     staging[task] = (wr, cost)
                 else:
+                    budget_blocked = True
                     break
             # Dispatch storage writes up to the backend's concurrency cap.
             while staged and len(io_tasks) < max_io:
@@ -135,8 +199,10 @@ async def execute_write_reqs(
                 io_req = IOReq(path=wr.path, data=buf)
 
                 async def _write(io_req=io_req, path=wr.path, n=len(buf)):
+                    t0 = time.monotonic()
                     with tracing.span("write", path=path, bytes=n):
                         await storage.write(io_req)
+                    _observe_op(ops, "write", time.monotonic() - t0, n)
 
                 task = asyncio.ensure_future(_write())
                 io_tasks[task] = len(buf)
@@ -144,9 +210,14 @@ async def execute_write_reqs(
             in_flight = set(staging) | set(io_tasks)
             if not in_flight:
                 continue
+            wait_t0 = time.monotonic()
             done, _ = await asyncio.wait(
                 in_flight, return_when=asyncio.FIRST_COMPLETED
             )
+            if budget_blocked:
+                # Work was ready to dispatch but the budget said no: the
+                # time until the next completion is budget-wait stall.
+                stall_s += time.monotonic() - wait_t0
             for task in done:
                 if task in staging:
                     wr, cost = staging.pop(task)
@@ -161,10 +232,20 @@ async def execute_write_reqs(
     finally:
         executor.shutdown(wait=False)
     elapsed = time.monotonic() - begin_ts
+    _merge_stats(
+        stats,
+        "write",
+        bytes_written,
+        stall_s,
+        memory_budget_bytes - min_budget,
+        ops,
+    )
     mbps = bytes_written / 1024 / 1024 / elapsed if elapsed > 0 else 0.0
     logger.info(
-        f"Rank {rank} finished saving ({bytes_written} bytes). "
-        f"Throughput: {mbps:.2f} MB/s"
+        "Rank %d finished saving (%d bytes). Throughput: %.2f MB/s",
+        rank,
+        bytes_written,
+        mbps,
     )
     return bytes_written
 
@@ -198,6 +279,7 @@ async def execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     device_budget_bytes: Optional[int] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> int:
     """Run the read→consume pipeline; returns total bytes read.
 
@@ -207,8 +289,14 @@ async def execute_read_reqs(
     unbounded. At least one consume always dispatches so an over-budget
     region cannot deadlock the pipeline; releases arrive through the
     consumers' device releasers when assembly frees the chunks.
+
+    ``stats`` (optional) accumulates exact per-run aggregates for the
+    flight recorder, as in :func:`execute_write_reqs`.
     """
     begin_ts = time.monotonic()
+    min_budget = memory_budget_bytes
+    stall_s = 0.0
+    ops: Dict[str, Dict[str, Any]] = {}
 
     # Largest LOGICAL objects first: a big object issued last would gate
     # the restore's tail all alone after the small reads drain (VERDICT
@@ -237,6 +325,7 @@ async def execute_read_reqs(
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
     try:
         while pending or reading or consumable or consuming:
+            budget_blocked = False
             while pending and len(reading) < max_io:
                 consumer = pending[0].buffer_consumer
                 cost = consumer.get_consuming_cost_bytes()
@@ -244,14 +333,22 @@ async def execute_read_reqs(
                 if budget.value >= cost or nothing_in_flight:
                     rr = pending.popleft()
                     budget.charge(cost)
+                    min_budget = min(min_budget, budget.value)
                     deferred = consumer.get_deferred_cost_bytes()
                     if deferred:
                         consumer.set_cost_releaser(budget.release)
                     io_req = IOReq(path=rr.path, byte_range=rr.byte_range)
 
                     async def _read(io_req=io_req, path=rr.path) -> IOReq:
+                        t0 = time.monotonic()
                         with tracing.span("read", path=path):
                             await storage.read(io_req)
+                        _observe_op(
+                            ops,
+                            "read",
+                            time.monotonic() - t0,
+                            len(io_payload(io_req)),
+                        )
                         return io_req
 
                     task = asyncio.ensure_future(_read())
@@ -259,6 +356,7 @@ async def execute_read_reqs(
                     # portion, which the consumer releases itself.
                     reading[task] = (rr, cost - deferred)
                 else:
+                    budget_blocked = True
                     break
 
             # Dispatch consumes under the device budget. The scan skips
@@ -278,6 +376,10 @@ async def execute_read_reqs(
                         break
                 if pick is None:
                     if reading or consuming:
+                        # Device-budget wait is stall too: consumable
+                        # work exists but cannot dispatch until budget
+                        # frees.
+                        budget_blocked = True
                         break
                     pick = 0
                 rr, buf, host_refund = consumable[pick]
@@ -289,8 +391,12 @@ async def execute_read_reqs(
                     consumer.set_device_cost_releaser(device_budget.release)
 
                 async def _consume(rr=rr, buf=buf):
+                    t0 = time.monotonic()
                     with tracing.span("consume", path=rr.path, bytes=len(buf)):
                         await rr.buffer_consumer.consume_buffer(buf, executor)
+                    _observe_op(
+                        ops, "consume", time.monotonic() - t0, len(buf)
+                    )
 
                 consume_task = asyncio.ensure_future(_consume())
                 consuming[consume_task] = host_refund
@@ -298,9 +404,12 @@ async def execute_read_reqs(
             in_flight = set(reading) | set(consuming)
             if not in_flight:
                 continue
+            wait_t0 = time.monotonic()
             done, _ = await asyncio.wait(
                 in_flight, return_when=asyncio.FIRST_COMPLETED
             )
+            if budget_blocked:
+                stall_s += time.monotonic() - wait_t0
             for task in done:
                 if task in reading:
                     rr, cost = reading.pop(task)
@@ -314,9 +423,19 @@ async def execute_read_reqs(
     finally:
         executor.shutdown(wait=False)
     elapsed = time.monotonic() - begin_ts
+    _merge_stats(
+        stats,
+        "read",
+        bytes_read,
+        stall_s,
+        memory_budget_bytes - min_budget,
+        ops,
+    )
     mbps = bytes_read / 1024 / 1024 / elapsed if elapsed > 0 else 0.0
     logger.info(
-        f"Rank {rank} finished loading ({bytes_read} bytes). "
-        f"Throughput: {mbps:.2f} MB/s"
+        "Rank %d finished loading (%d bytes). Throughput: %.2f MB/s",
+        rank,
+        bytes_read,
+        mbps,
     )
     return bytes_read

@@ -48,8 +48,11 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from . import tracing
+from . import telemetry, tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
+from .telemetry import export as telemetry_export
+from .telemetry import metrics as _metric_names
+from .telemetry import report as flight
 from .flatten import flatten, inflate
 from .io_preparer import (
     ArrayBufferStager,
@@ -274,6 +277,21 @@ class Snapshot:
         rng_key, rng_stateful = _pop_rng_state(app_state)
         rng_captured: Optional[Dict[str, Any]] = None
 
+        # Flight recorder (telemetry/report.py): one per rank per take;
+        # phase timings + pipeline stats + metric deltas become the
+        # rank's summary in the committed .report.json. Observability
+        # only — nothing below may fail the take through it.
+        recorder = flight.FlightRecorder(
+            kind="take" if background is None else "async_take",
+            path=path,
+            rank=rank,
+        )
+        telemetry.counter(
+            _metric_names.TAKES_TOTAL,
+            mode="sync" if background is None else "async",
+        ).inc()
+        capture_t0 = time.monotonic()
+
         manifest: Manifest = {}
         pending_write_reqs: List[WriteReq] = []
 
@@ -317,6 +335,8 @@ class Snapshot:
             )
             coordinator.barrier()
 
+        recorder.add_phase("capture", time.monotonic() - capture_t0)
+
         # Incremental/fingerprint pass (beyond parity — see incremental.py).
         # Runs BEFORE staging/cloning so a dedup hit skips the device→host
         # transfer (and, async, the device clone), not just the storage
@@ -332,7 +352,9 @@ class Snapshot:
         if base_path is not None or fingerprint_enabled:
             from .incremental import apply_incremental
 
-            with tracing.span("Snapshot.incremental", path=path):
+            with recorder.phase("incremental"), tracing.span(
+                "Snapshot.incremental", path=path
+            ):
                 base_paths_meta, _ = apply_incremental(
                     manifest,
                     pending_write_reqs,
@@ -356,9 +378,18 @@ class Snapshot:
         merged_metadata: Optional[SnapshotMetadata] = None
 
         if background is None:
-            asyncio.run(
-                execute_write_reqs(pending_write_reqs, storage, budget, rank)
-            )
+            write_stats: Dict[str, Any] = {}
+            with recorder.phase("write"):
+                asyncio.run(
+                    execute_write_reqs(
+                        pending_write_reqs,
+                        storage,
+                        budget,
+                        rank,
+                        stats=write_stats,
+                    )
+                )
+            recorder.note_pipeline(write_stats)
             # Route the manifest transport by size. The decision must be
             # identical on every rank (divergent routes deadlock: some
             # ranks would block in the KV all-gather, others in marker
@@ -392,16 +423,22 @@ class Snapshot:
                 # finished, preserving metadata-last ordering. The final
                 # barrier holds every rank until rank 0's metadata write
                 # (its barrier key is set only after asyncio.run returns).
-                merged_metadata = asyncio.run(
-                    _acommit_via_storage(
-                        storage,
-                        rank,
-                        coordinator.get_world_size(),
-                        manifest,
-                        take_id,
-                        base_paths=base_paths_meta,
+                # Flight summaries ride per-rank storage objects on this
+                # route (the same transport as the manifests).
+                with recorder.phase("commit"):
+                    merged_metadata = asyncio.run(
+                        _acommit_via_storage(
+                            storage,
+                            rank,
+                            coordinator.get_world_size(),
+                            manifest,
+                            take_id,
+                            base_paths=base_paths_meta,
+                            rank_summary=recorder.rank_summary(),
+                            kind="take",
+                            snapshot_path=path,
+                        )
                     )
-                )
             else:
                 # This route writes no per-rank storage marker, so it is
                 # each rank's last chance to settle deferred durability
@@ -413,14 +450,33 @@ class Snapshot:
                 # barrier: rank 0 holds every rank's manifest only after
                 # every rank finished its writes, so metadata-last
                 # ordering is guaranteed.
-                metadata = _gather_manifest(
-                    coordinator,
-                    manifest,
-                    take_id=take_id,
-                    base_paths=base_paths_meta,
+                with recorder.phase("commit"):
+                    metadata = _gather_manifest(
+                        coordinator,
+                        manifest,
+                        take_id=take_id,
+                        base_paths=base_paths_meta,
+                    )
+                    if rank == 0:
+                        _write_snapshot_metadata(storage, metadata)
+                # Flight summaries ride the coordinator on this route
+                # (they are kilobytes, like everything else on it). The
+                # gather is unconditional — every rank must issue the
+                # identical collective sequence.
+                summaries = coordinator.all_gather_object(
+                    recorder.rank_summary()
                 )
                 if rank == 0:
-                    _write_snapshot_metadata(storage, metadata)
+                    _write_report_best_effort(
+                        storage,
+                        flight.build_report(
+                            "take",
+                            path,
+                            take_id,
+                            coordinator.get_world_size(),
+                            summaries,
+                        ),
+                    )
                 # The all-gather gave EVERY rank the merged view; the
                 # caller seeds its handle's cache with it.
                 merged_metadata = metadata
@@ -430,6 +486,7 @@ class Snapshot:
             # exceed the coordinator's default store timeout at scale, so
             # the barrier must wait at least as long (ADVICE r3).
             barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
+            flight.local_export(recorder)
         else:
             # Async take. All *collectives* run in the foreground (they are
             # kilobytes over the KV store); storage writes and the manifest
@@ -444,9 +501,13 @@ class Snapshot:
             # Holding the caller's device arrays lazily would break under
             # jit buffer donation (the next training step deletes the
             # snapshotted buffers).
-            _prestage_write_reqs(
-                pending_write_reqs, budget, stage=stage, coordinator=coordinator
-            )
+            with recorder.phase("prestage"):
+                _prestage_write_reqs(
+                    pending_write_reqs,
+                    budget,
+                    stage=stage,
+                    coordinator=coordinator,
+                )
 
             # Per-take nonce: completion markers and the metadata document
             # from concurrent/previous takes to the same path must never
@@ -461,15 +522,26 @@ class Snapshot:
             def _drain() -> None:
                 async def _run() -> None:
                     background.phase = "storage writes"
+                    write_stats: Dict[str, Any] = {}
+                    drain_t0 = time.monotonic()
                     await execute_write_reqs(
-                        pending_write_reqs, storage, budget, rank
+                        pending_write_reqs,
+                        storage,
+                        budget,
+                        rank,
+                        stats=write_stats,
                     )
+                    recorder.add_phase(
+                        "write", time.monotonic() - drain_t0
+                    )
+                    recorder.note_pipeline(write_stats)
                     background.phase = "commit markers"
                     # The completion marker carries this rank's local
                     # manifest. It must be serialized *after* this rank's
                     # writes finish: staging back-patches payload checksums
                     # into the entries, and under a device-staged cut
                     # staging itself runs in this background drain.
+                    commit_t0 = time.monotonic()
                     await _acommit_via_storage(
                         storage,
                         rank,
@@ -477,7 +549,14 @@ class Snapshot:
                         manifest,
                         nonce,
                         base_paths=base_paths_meta,
+                        rank_summary=recorder.rank_summary(),
+                        kind="async_take",
+                        snapshot_path=path,
                     )
+                    recorder.add_phase(
+                        "commit", time.monotonic() - commit_t0
+                    )
+                    flight.local_export(recorder)
 
                 asyncio.run(_run())
 
@@ -536,6 +615,15 @@ class Snapshot:
         metadata = self._read_snapshot_metadata(storage)
         available = get_available_entries(metadata.manifest, rank)
 
+        # Rank-local flight record: the read/consume/assemble breakdown
+        # that names a consume-dominated restore (BENCH_r05) from a file
+        # instead of a trace viewer. Written best-effort at the end.
+        recorder = flight.FlightRecorder(
+            kind="restore", path=self.path, rank=rank
+        )
+        telemetry.counter(_metric_names.RESTORES_TOTAL).inc()
+        read_stats: Dict[str, Any] = {}
+
         app_state = dict(app_state)
         rng_key, rng_stateful = _pop_rng_state(app_state)
 
@@ -557,6 +645,7 @@ class Snapshot:
                     snapshot_world_size=metadata.world_size,
                     path_globs=paths,
                     verify_jobs_out=verify_jobs if verify_device else None,
+                    stats=read_stats,
                 )
             coordinator.barrier()
 
@@ -574,7 +663,11 @@ class Snapshot:
                 snapshot_world_size=metadata.world_size,
                 path_globs=paths,
                 verify_jobs_out=verify_jobs if verify_device else None,
+                stats=read_stats,
             )
+        self._finish_restore_report(
+            recorder, read_stats, storage, rank, coordinator.get_world_size()
+        )
         if verify_device:
             verified, skipped = _verify_restored_fingerprints(verify_jobs)
             logger.info(
@@ -593,6 +686,51 @@ class Snapshot:
                 f'"<stateful_key>/<flattened/path>", e.g. '
                 f'"model/params/w"; see get_manifest().'
             )
+
+    def _finish_restore_report(
+        self,
+        recorder: Any,
+        read_stats: Dict[str, Any],
+        storage: StoragePlugin,
+        rank: int,
+        world_size: int,
+    ) -> None:
+        """Fold the read pipeline's stats into the flight recorder and
+        write the rank-local restore report beside the manifest.
+        Best-effort throughout: a read-only snapshot location (or any
+        storage failure) must never fail the restore it describes."""
+        assemble_s = read_stats.pop("assemble_s", 0.0)
+        recorder.note_pipeline(read_stats)
+        ops = read_stats.get("ops") or {}
+        recorder.add_phase(
+            "read", (ops.get("read") or {}).get("seconds", 0.0)
+        )
+        recorder.add_phase(
+            "consume", (ops.get("consume") or {}).get("seconds", 0.0)
+        )
+        recorder.add_phase("assemble", assemble_s)
+        try:
+            # ranks holds only THIS rank's summary (the report is
+            # rank-local by design — restore runs no extra collectives),
+            # but world_size records the real restoring world so the
+            # rendering doesn't claim a single-rank job.
+            report = flight.build_report(
+                "restore",
+                self.path,
+                None,
+                world_size,
+                [recorder.rank_summary()],
+            )
+            asyncio.run(
+                flight.awrite_json(
+                    storage, flight.restore_report_fname(rank), report
+                )
+            )
+        except Exception as e:
+            # debug, not warning: restoring from a read-only location is
+            # legitimate and would otherwise warn on every restore.
+            logger.debug("restore flight-record write failed: %r", e)
+        flight.local_export(recorder)
 
     def delete(self, sweep: bool = False, force: bool = False) -> None:
         """Delete this snapshot from storage (beyond reference parity —
@@ -694,6 +832,15 @@ class Snapshot:
             own_markers = asyncio.run(storage.list_prefix(REFS_PREFIX))
             if own_markers:
                 markers = markers + list(own_markers)
+            # Flight records (.report.json, per-rank .report/* summaries,
+            # .report.restore.rank*.json) are ours too; deleting them
+            # explicitly keeps a plain (sweep-less) delete complete and
+            # keeps them out of the sweep age guard's way.
+            own_reports = asyncio.run(
+                storage.list_prefix(flight.REPORT_PREFIX)
+            )
+            if own_reports:
+                markers = markers + list(own_reports)
 
             async def _delete_all() -> None:
                 # Uncommit first; then payload deletes are order-
@@ -2126,6 +2273,7 @@ def _load_stateful(
     snapshot_world_size: int,
     path_globs: Optional[List[str]] = None,
     verify_jobs_out: Optional[List[Tuple[str, Entry, Any]]] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> int:
     """Returns the number of leaves restored (callers detect no-op filters)."""
     # In-place restore strategy (reference snapshot.py:374-381): the
@@ -2177,10 +2325,18 @@ def _load_stateful(
             budget,
             rank,
             device_budget_bytes=get_device_restore_budget_bytes(),
+            stats=stats,
         )
     )
+    assemble_t0 = time.monotonic()
     for finalize in finalizers:
         finalize()
+    if stats is not None:
+        # Assembly (split-read reconstruction, device placement
+        # finalizers) is the third leg of the restore breakdown.
+        stats["assemble_s"] = stats.get("assemble_s", 0.0) + (
+            time.monotonic() - assemble_t0
+        )
 
     if verify_jobs_out is not None:
         for logical_path in sorted(selected):
@@ -2500,6 +2656,9 @@ async def _acommit_via_storage(
     manifest: Manifest,
     take_id: str,
     base_paths: Optional[List[str]] = None,
+    rank_summary: Optional[Dict[str, Any]] = None,
+    kind: str = "take",
+    snapshot_path: str = "",
 ) -> Optional[SnapshotMetadata]:
     """Commit by completion markers: every rank writes its local manifest
     to ``.completed/<take_id>/<rank>``; rank 0 polls all markers, merges,
@@ -2508,7 +2667,26 @@ async def _acommit_via_storage(
     must barrier afterwards if it needs commit-before-return semantics.
     ``base_paths`` is rank-deterministic (see apply_incremental), so
     rank 0's copy standing in for everyone's is exact, not approximate.
-    Returns the merged metadata on rank 0 (None elsewhere)."""
+    Returns the merged metadata on rank 0 (None elsewhere).
+
+    ``rank_summary`` (flight recorder) rides storage — never the
+    coordinator, which the async drain must not touch: ranks != 0 write
+    ``.report/<take_id>/<rank>`` BEFORE their completion marker (so the
+    summaries are guaranteed present once the markers are), and rank 0
+    merges them into the ``.report.json`` written after the metadata
+    document. All report IO is best-effort: observability must never
+    fail (or gate) the commit."""
+    if rank_summary is not None and rank != 0:
+        try:
+            await flight.awrite_json(
+                storage, flight.rank_report_path(take_id, rank), rank_summary
+            )
+        except Exception as e:
+            logger.warning(
+                "flight-record summary write for rank %d failed: %r",
+                rank,
+                e,
+            )
     marker = IOReq(path=f".completed/{take_id}/{rank}")
     marker.buf.write(
         _encode_metadata_doc(
@@ -2545,6 +2723,41 @@ async def _acommit_via_storage(
                     f".completed/{take_id}/{r} failed",
                     exc_info=True,
                 )
+        if rank_summary is not None:
+            # Summaries are guaranteed written before their rank's
+            # marker, and every marker has been collected — one
+            # best-effort read per rank, no polling. A missing summary
+            # records as null in the report (the gap stays visible).
+            summaries: List[Optional[Dict[str, Any]]] = [rank_summary]
+            for r in range(1, world_size):
+                summaries.append(
+                    await flight.aread_json(
+                        storage, flight.rank_report_path(take_id, r)
+                    )
+                )
+            try:
+                await flight.awrite_json(
+                    storage,
+                    flight.REPORT_FNAME,
+                    flight.build_report(
+                        kind, snapshot_path, take_id, world_size, summaries
+                    ),
+                )
+            except Exception as e:
+                logger.warning("flight-record report write failed: %r", e)
+            for r in range(1, world_size):
+                try:
+                    await _delete_ignore_missing(
+                        storage, flight.rank_report_path(take_id, r)
+                    )
+                except Exception:
+                    # Leftover summary objects are inert (and swept by
+                    # delete/reconcile); never fail a committed take.
+                    logger.debug(
+                        f"cleanup of flight summary "
+                        f"{flight.rank_report_path(take_id, r)} failed",
+                        exc_info=True,
+                    )
         return metadata
     return None
 
@@ -2568,3 +2781,14 @@ async def _awrite_snapshot_metadata(
 
 def _write_snapshot_metadata(storage: StoragePlugin, metadata: SnapshotMetadata) -> None:
     asyncio.run(_awrite_snapshot_metadata(storage, metadata))
+
+
+def _write_report_best_effort(storage: StoragePlugin, report: Dict[str, Any]) -> None:
+    """Write a flight-record document; never fail the operation it
+    describes (observability-only contract). A SimulatedCrash
+    (BaseException) still rips through — a crashed process must not
+    look like one that merely failed to report."""
+    try:
+        asyncio.run(flight.awrite_json(storage, flight.REPORT_FNAME, report))
+    except Exception as e:
+        logger.warning("flight-record report write failed: %r", e)

@@ -12,9 +12,17 @@ import asyncio
 import errno
 import os
 import threading
+import time
 from typing import Optional, Set, Tuple
 
+from .. import telemetry
 from ..io_types import IOReq, StoragePlugin, emit_storage_op
+
+
+def _payload_nbytes(io_req: IOReq) -> int:
+    if io_req.data is not None:
+        return len(io_req.data)
+    return io_req.buf.getbuffer().nbytes
 
 
 def _fsync_dir(path: str) -> None:
@@ -207,15 +215,26 @@ class FSStoragePlugin(StoragePlugin):
 
     async def write(self, io_req: IOReq) -> None:
         loop = asyncio.get_running_loop()
+        nbytes = _payload_nbytes(io_req)
+        t0 = time.monotonic()
         await loop.run_in_executor(None, self._write_sync, io_req)
+        telemetry.record_storage_op(
+            "fs", "write", time.monotonic() - t0, nbytes
+        )
 
     async def read(self, io_req: IOReq) -> None:
         loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
         await loop.run_in_executor(None, self._read_sync, io_req)
+        telemetry.record_storage_op(
+            "fs", "read", time.monotonic() - t0, _payload_nbytes(io_req)
+        )
 
     async def delete(self, path: str) -> None:
         loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
         await loop.run_in_executor(None, os.remove, os.path.join(self.root, path))
+        telemetry.record_storage_op("fs", "delete", time.monotonic() - t0)
 
     def _list_sync(self, prefix: str):
         # Object-store semantics: a pure string prefix over relative

@@ -11,10 +11,12 @@ overlapping byte ranges.
 import asyncio
 import logging
 import os
+import time as _time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
+from .. import telemetry
 from ..io_types import IOReq, StoragePlugin, io_payload
 
 logger = logging.getLogger(__name__)
@@ -166,21 +168,34 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def write(self, io_req: IOReq) -> None:
         payload = io_payload(io_req)
+        t0 = _time.monotonic()
         if len(payload) >= _parallel_upload_threshold():
             # Orchestrated from the event loop (no executor thread blocks
             # waiting on part futures — the 8 IO threads all push bytes).
             await self._parallel_composite_upload(io_req.path, payload)
-            return
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(self._executor, self._write_sync, io_req)
+        else:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(self._executor, self._write_sync, io_req)
+        telemetry.record_storage_op(
+            "gcs", "write", _time.monotonic() - t0, len(payload)
+        )
 
     async def read(self, io_req: IOReq) -> None:
         loop = asyncio.get_running_loop()
+        t0 = _time.monotonic()
         await loop.run_in_executor(self._executor, self._read_sync, io_req)
+        telemetry.record_storage_op(
+            "gcs",
+            "read",
+            _time.monotonic() - t0,
+            len(io_req.data) if io_req.data is not None else 0,
+        )
 
     async def delete(self, path: str) -> None:
         loop = asyncio.get_running_loop()
+        t0 = _time.monotonic()
         await loop.run_in_executor(self._executor, self._blob(path).delete)
+        telemetry.record_storage_op("gcs", "delete", _time.monotonic() - t0)
 
     def _list_sync(self, prefix: str):
         full_prefix = f"{self.root}/{prefix}" if prefix else f"{self.root}/"

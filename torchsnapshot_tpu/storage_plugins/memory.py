@@ -5,9 +5,11 @@ handy model of an object store (flat key → bytes, ranged reads).
 """
 
 import asyncio
+import time as _time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+from .. import telemetry
 from ..io_types import IOReq, StoragePlugin
 
 # Shared-store -> mtimes registry. Keyed by id() with a strong reference
@@ -60,12 +62,17 @@ class MemoryStoragePlugin(StoragePlugin):
     async def write(self, io_req: IOReq) -> None:
         import time
 
+        t0 = _time.monotonic()
         payload = io_req.data if io_req.data is not None else io_req.buf.getbuffer()
         async with self._lock:
             self.store[self._key(io_req.path)] = bytes(payload)
             self._mtimes[self._key(io_req.path)] = time.time()
+        telemetry.record_storage_op(
+            "memory", "write", _time.monotonic() - t0, len(payload)
+        )
 
     async def read(self, io_req: IOReq) -> None:
+        t0 = _time.monotonic()
         async with self._lock:
             try:
                 data = self.store[self._key(io_req.path)]
@@ -77,14 +84,21 @@ class MemoryStoragePlugin(StoragePlugin):
             start, end = io_req.byte_range
             data = data[start:end]
         io_req.data = data
+        telemetry.record_storage_op(
+            "memory", "read", _time.monotonic() - t0, len(data)
+        )
 
     async def delete(self, path: str) -> None:
+        t0 = _time.monotonic()
         async with self._lock:
             key = self._key(path)
             if key not in self.store:
                 raise FileNotFoundError(path)
             del self.store[key]
             self._mtimes.pop(key, None)
+        telemetry.record_storage_op(
+            "memory", "delete", _time.monotonic() - t0
+        )
 
     async def list_prefix(self, prefix: str):
         full = self._key(prefix)

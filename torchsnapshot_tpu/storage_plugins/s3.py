@@ -8,9 +8,11 @@ installed (optional-import pattern, reference s3.py:16-22).
 """
 
 import asyncio
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
+from .. import telemetry
 from ..io_types import IOReq, StoragePlugin
 
 _IO_THREADS = 8
@@ -56,6 +58,7 @@ class S3StoragePlugin(StoragePlugin):
         else:
             io_req.buf.seek(0)
             body = io_req.buf.getvalue()
+        t0 = _time.monotonic()
         if self._mode == "aio":
             async with self._session.create_client("s3") as client:
                 await client.put_object(
@@ -69,12 +72,16 @@ class S3StoragePlugin(StoragePlugin):
                     Bucket=self.bucket, Key=self._key(io_req.path), Body=body
                 ),
             )
+        telemetry.record_storage_op(
+            "s3", "write", _time.monotonic() - t0, len(body)
+        )
 
     async def read(self, io_req: IOReq) -> None:
         range_hdr = None
         if io_req.byte_range is not None:
             start, end = io_req.byte_range
             range_hdr = f"bytes={start}-{end - 1}"
+        t0 = _time.monotonic()
         if self._mode == "aio":
             async with self._session.create_client("s3") as client:
                 kwargs = {"Bucket": self.bucket, "Key": self._key(io_req.path)}
@@ -93,8 +100,15 @@ class S3StoragePlugin(StoragePlugin):
                 return self._client.get_object(**kwargs)["Body"].read()
 
             io_req.data = await loop.run_in_executor(self._executor, _get)
+        telemetry.record_storage_op(
+            "s3",
+            "read",
+            _time.monotonic() - t0,
+            len(io_req.data) if io_req.data is not None else 0,
+        )
 
     async def delete(self, path: str) -> None:
+        t0 = _time.monotonic()
         if self._mode == "aio":
             async with self._session.create_client("s3") as client:
                 await client.delete_object(Bucket=self.bucket, Key=self._key(path))
@@ -106,6 +120,7 @@ class S3StoragePlugin(StoragePlugin):
                     Bucket=self.bucket, Key=self._key(path)
                 ),
             )
+        telemetry.record_storage_op("s3", "delete", _time.monotonic() - t0)
 
     async def list_prefix(self, prefix: str):
         full_prefix = f"{self.root}/{prefix}" if prefix else f"{self.root}/"

@@ -1,0 +1,326 @@
+"""Always-on, thread-safe metrics primitives (beyond reference parity).
+
+The reference's only instrumentation is a per-rank throughput log line
+(SURVEY §5: "Tracing/profiling: none"); ``tracing.py`` spans are opt-in
+and write-only. This module is the third leg: cheap counters, gauges,
+and log-bucketed histograms that are ALWAYS recording, so "how many
+storage retries did this job eat" and "what is the p99 write latency"
+are answerable without having had the foresight to enable anything.
+
+Design constraints:
+
+- **Always on, cheap.** One dict lookup plus one short lock hold per
+  observation; no background threads, no sockets, no deps. Callers on
+  hot paths fetch the metric handle once and reuse it.
+- **Thread-safe.** The scheduler observes from the event loop, staging
+  observes from executor threads, async-take drains observe from the
+  background thread. Every metric guards its state with its own lock
+  (SNAP005 ``lockset`` analyzes this module).
+- **Bounded cardinality.** Labels identify *types* (op kind, backend,
+  phase) — never paths, steps, or ranks-at-pod-scale. A registry is a
+  process-wide dict; unbounded label values would grow it forever.
+- **Snapshot-able.** :meth:`MetricsRegistry.snapshot` returns plain
+  JSON-able data; :func:`diff_snapshots` subtracts two snapshots so the
+  flight recorder can attribute per-operation deltas.
+
+Histogram buckets are log2-spaced (…, 0.25, 0.5, 1, 2, 4, …): one
+bucket per power of two covers nanoseconds→hours and bytes→terabytes in
+~60 buckets with a fixed relative error, with no per-unit tuning.
+"""
+
+import math
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> LabelsKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def format_sample_name(name: str, labels_key: LabelsKey) -> str:
+    """Prometheus-style sample identity: ``name{k="v",...}`` (bare name
+    when label-less). Used as the key in :meth:`MetricsRegistry.snapshot`
+    output so snapshots read like exposition lines."""
+    if not labels_key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels_key)
+    return f"{name}{{{inner}}}"
+
+
+def bucket_le(value: float) -> float:
+    """The log2 bucket upper bound covering ``value`` (inclusive)."""
+    if value <= 0:
+        return 0.0
+    exp = math.ceil(math.log2(value))
+    le = float(2.0 ** exp)
+    # Guard the edge where float log2 of an exact power rounds down.
+    if le < value:
+        le = float(2.0 ** (exp + 1))
+    return le
+
+
+class Counter:
+    """Monotonic accumulator (float-valued: backoff seconds count too)."""
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: Union[int, float] = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"Counter increments must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def collect(self) -> float:
+        return self.value
+
+
+class Gauge:
+    """Point-in-time value; ``set_max`` tracks a high-water mark."""
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: Union[int, float]) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def set_max(self, value: Union[int, float]) -> None:
+        with self._lock:
+            if value > self._value:
+                self._value = float(value)
+
+    def add(self, amount: Union[int, float]) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def collect(self) -> float:
+        return self.value
+
+
+class Histogram:
+    """Log2-bucketed distribution: sparse ``{le: count}`` + sum + count."""
+
+    def __init__(self) -> None:
+        self._buckets: Dict[float, int] = {}
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: Union[int, float]) -> None:
+        le = bucket_le(float(value))
+        with self._lock:
+            self._buckets[le] = self._buckets.get(le, 0) + 1
+            self._sum += value
+            self._count += 1
+
+    def collect(self) -> Dict[str, Any]:
+        """``{"count", "sum", "buckets"}`` with buckets keyed by the
+        stringified upper bound (JSON object keys must be strings)."""
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "buckets": {
+                    f"{le:g}": n for le, n in sorted(self._buckets.items())
+                },
+            }
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+
+MetricType = Union[Counter, Gauge, Histogram]
+
+
+class MetricsRegistry:
+    """Process-wide named metric store.
+
+    ``counter``/``gauge``/``histogram`` get-or-create by (name, labels);
+    a name is bound to exactly one metric kind — asking for the same
+    name as a different kind raises (the exporter could not represent
+    it, and the collision is always a bug).
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[Tuple[str, LabelsKey], MetricType] = {}
+        self._kinds: Dict[str, type] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(
+        self, name: str, kind: type, labels: Dict[str, str]
+    ) -> MetricType:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            bound = self._kinds.get(name)
+            if bound is not None and bound is not kind:
+                raise ValueError(
+                    f"Metric {name!r} is already registered as "
+                    f"{bound.__name__}; cannot re-register as "
+                    f"{kind.__name__}"
+                )
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = kind()
+                self._metrics[key] = metric
+                self._kinds[name] = kind
+            return metric
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get_or_create(name, Counter, labels)  # type: ignore[return-value]
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get_or_create(name, Gauge, labels)  # type: ignore[return-value]
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get_or_create(name, Histogram, labels)  # type: ignore[return-value]
+
+    def kind_of(self, name: str) -> Optional[str]:
+        with self._lock:
+            kind = self._kinds.get(name)
+        return None if kind is None else kind.__name__.lower()
+
+    def items(self) -> List[Tuple[str, LabelsKey, MetricType]]:
+        """Stable-ordered (name, labels, metric) triples."""
+        with self._lock:
+            entries = list(self._metrics.items())
+        return sorted(
+            ((name, lk, m) for (name, lk), m in entries),
+            key=lambda t: (t[0], t[1]),
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """All current values as plain data, keyed by the Prometheus-style
+        sample identity: counters/gauges map to floats, histograms to
+        ``{"count", "sum", "buckets"}`` dicts. This is the programmatic
+        export API — JSON-able as-is."""
+        out: Dict[str, Any] = {}
+        for name, labels_key, metric in self.items():
+            out[format_sample_name(name, labels_key)] = metric.collect()
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (tests; never called by library code)."""
+        with self._lock:
+            self._metrics.clear()
+            self._kinds.clear()
+
+
+def diff_snapshots(
+    before: Dict[str, Any], after: Dict[str, Any]
+) -> Dict[str, Any]:
+    """``after - before`` per sample, for attributing one operation's
+    activity out of process-lifetime totals. Counters/gauges subtract;
+    histograms subtract count/sum/buckets. Samples born after ``before``
+    diff against zero; zero-delta samples are dropped."""
+    out: Dict[str, Any] = {}
+    for key, now in after.items():
+        prev = before.get(key)
+        if isinstance(now, dict):
+            prev = prev if isinstance(prev, dict) else {}
+            count = now.get("count", 0) - prev.get("count", 0)
+            if count == 0:
+                continue
+            prev_buckets = prev.get("buckets", {})
+            buckets = {
+                le: n - prev_buckets.get(le, 0)
+                for le, n in now.get("buckets", {}).items()
+                if n - prev_buckets.get(le, 0)
+            }
+            out[key] = {
+                "count": count,
+                "sum": now.get("sum", 0.0) - prev.get("sum", 0.0),
+                "buckets": buckets,
+            }
+        else:
+            delta = now - (prev if isinstance(prev, (int, float)) else 0.0)
+            if delta:
+                out[key] = delta
+    return out
+
+
+def sum_samples(snapshot: Dict[str, Any], name: str) -> float:
+    """Sum a scalar metric's samples across all label sets (histograms
+    contribute their ``sum``)."""
+    total = 0.0
+    for key, value in snapshot.items():
+        if key == name or key.startswith(name + "{"):
+            total += value["sum"] if isinstance(value, dict) else value
+    return total
+
+
+def samples_by_label(
+    snapshot: Dict[str, Any], name: str, label: str
+) -> Dict[str, Any]:
+    """``{label_value: sample}`` for one metric name. Samples lacking the
+    label land under ``""``."""
+    out: Dict[str, Any] = {}
+    prefix = name + "{"
+    needle = f'{label}="'
+    for key, value in snapshot.items():
+        if key != name and not key.startswith(prefix):
+            continue
+        label_value = ""
+        if "{" in key:
+            inner = key[key.index("{") + 1 : -1]
+            for part in inner.split(","):
+                if part.startswith(needle):
+                    label_value = part[len(needle) : -1]
+                    break
+        out[label_value] = value
+    return out
+
+
+# The process-wide default registry: library instrumentation records
+# here; ``telemetry.snapshot()`` / the exporters read it.
+REGISTRY = MetricsRegistry()
+
+
+# ------------------------------------------------------------ metric catalog
+#
+# Every metric the library records, by name (docs/OBSERVABILITY.md is the
+# narrative companion). Label sets are bounded by construction: op kinds,
+# backend protocols, fault kinds — never paths, steps, or object names.
+
+STORAGE_OP_SECONDS = "tpusnapshot_storage_op_seconds"  # hist {backend,op}
+STORAGE_OP_BYTES = "tpusnapshot_storage_op_payload_bytes"  # hist {backend,op}
+STORAGE_RETRIES = "tpusnapshot_storage_retries_total"  # counter {op}
+STORAGE_RETRY_BACKOFF = (
+    "tpusnapshot_storage_retry_backoff_seconds_total"  # counter {op}
+)
+FAULTS_INJECTED = "tpusnapshot_faults_injected_total"  # counter {kind}
+SCHED_OP_SECONDS = "tpusnapshot_scheduler_op_seconds"  # hist {op}
+SCHED_OP_BYTES = "tpusnapshot_scheduler_op_bytes"  # hist {op}
+SCHED_STALL_SECONDS = (
+    "tpusnapshot_scheduler_budget_stall_seconds_total"  # counter {pipeline}
+)
+SCHED_BUDGET_HWM = (
+    "tpusnapshot_scheduler_budget_high_water_bytes"  # gauge {pipeline}
+)
+COORD_WAIT_SECONDS = "tpusnapshot_coord_wait_seconds"  # hist {op}
+MANAGER_STEP_MARKER_SECONDS = "tpusnapshot_manager_step_marker_seconds"  # hist
+MANAGER_PRUNE_SECONDS = "tpusnapshot_manager_prune_seconds"  # hist
+MANAGER_STEPS_PRUNED = "tpusnapshot_manager_steps_pruned_total"  # counter
+TAKES_TOTAL = "tpusnapshot_takes_total"  # counter {mode}
+RESTORES_TOTAL = "tpusnapshot_restores_total"  # counter

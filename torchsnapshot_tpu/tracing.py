@@ -56,7 +56,11 @@ def enable(path: str) -> None:
 
 
 def disable() -> None:
+    """Stop recording. Flushes first: a programmatic enable→span→disable
+    sequence must not silently drop its spans (the previous behavior —
+    callers had to know to call flush() themselves)."""
     global _events, _path
+    flush()
     with _lock:
         _events = None
         _path = None
@@ -67,14 +71,32 @@ def enabled() -> bool:
 
 
 def flush() -> Optional[str]:
-    """Write accumulated events as Chrome trace JSON; returns the path."""
+    """Write accumulated events as Chrome trace JSON; returns the path.
+
+    Crash-safe: the document lands in a ``.tmp<pid>`` sibling and is
+    renamed into place, so a crash (or a concurrent reader — the
+    summarize CLI tailing a live run) never sees a torn, unloadable
+    trace where a previous flush's complete one existed.
+    """
     with _lock:
         if _events is None or _path is None:
             return None
         payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
         path = _path
-    with open(path, "w") as f:
-        json.dump(payload, f)
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    finally:
+        # A failed dump (disk full, crash between write and rename on
+        # this thread) must not leave .tmp debris next to the trace.
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            # Best-effort cleanup; the trace itself is intact either way.
+            except OSError:  # snapcheck: disable=swallowed-exception -- tmp cleanup
+                pass
     return path
 
 
