@@ -222,6 +222,12 @@ def _summary_doc() -> dict:
         "restore_read_span_s": r.get("restore_read_span_s", 0),
         "restore_consume_span_s": r.get("restore_consume_span_s", 0),
         "restore_assemble_span_s": r.get("restore_assemble_span_s", 0),
+        # telemetry.summarize's dominant-phase call + the doctor's rule
+        # hits for the timed restore: the BENCH JSON carries its own
+        # diagnosis (BENCH_r05 would have read "consume-dominated"
+        # here instead of needing a human to correlate span columns).
+        "phase_verdict": r.get("phase_verdict"),
+        "doctor_findings": r.get("doctor_findings"),
         "step_stall": r.get("step_stall"),
         "incremental": r.get("incremental"),
         "scaling": r.get("scaling"),
@@ -308,6 +314,48 @@ _MAX_BENCH_BYTES = 8 * 1024**3
 # ONE large storage object on the write side, and the concurrent
 # ranged-sub-read reassembly on restore.
 _BIG_PARAM_BYTES = 640 * 1024 * 1024
+
+
+def _phase_verdict(trace_path: str):
+    """telemetry.summarize's dominant-phase verdict for one trace —
+    embedded in the BENCH JSON so a regression reader sees WHICH phase
+    a slow run spent its time in without re-opening the trace."""
+    try:
+        from torchsnapshot_tpu.telemetry import summarize as _summarize
+
+        summary = _summarize.summarize(
+            _summarize.fold_spans(_summarize.load_events(trace_path))
+        )
+        return summary.get("verdict")
+    except Exception:
+        return None
+
+
+def _doctor_findings_for_spans(wall_s: float, spans: dict) -> list:
+    """telemetry.doctor findings for the timed restore, from a
+    rank-local report synthesized out of the trace's span sums — the
+    same shape the flight recorder commits, so the rule table applies
+    unchanged. Finding rule ids only (evidence lives in the trace)."""
+    try:
+        from torchsnapshot_tpu.telemetry import doctor as _doctor
+
+        report = {
+            "kind": "restore",
+            "ranks": [
+                {
+                    "rank": 0,
+                    "wall_s": wall_s,
+                    "phases": {
+                        f"{name}_s": round(total, 3)
+                        for name, (total, _n) in spans.items()
+                    },
+                }
+            ],
+            "totals": {},
+        }
+        return [f.rule for f in _doctor.diagnose_report(report)]
+    except Exception:
+        return []
 
 
 def _restore_trace_breakdown(trace_path: str) -> dict:
@@ -1064,7 +1112,13 @@ def _bench_body(bench_dir: str) -> None:
             # The CEILING is the better probe (same convention as the
             # D2H probe: interference only subtracts) — a mean could
             # report restore/ceiling above 1.0, which is meaningless.
-            return elapsed, max(before, after), spread, spans
+            return (
+                elapsed,
+                max(before, after),
+                spread,
+                spans,
+                _phase_verdict(trace_path),
+            )
 
         def _ratio(att):
             return (restored_gib / att[0]) / max(att[1], 1e-9)
@@ -1078,7 +1132,9 @@ def _bench_body(bench_dir: str) -> None:
         def _record_restore(attempts_so_far) -> None:
             # Incremental: a supervisor cut mid-retry still reports the
             # best completed attempt.
-            el, ceil, spread, spans = max(attempts_so_far, key=_ratio)
+            el, ceil, spread, spans, verdict = max(
+                attempts_so_far, key=_ratio
+            )
             r_gbps = restored_gib / el
             r_ratio = r_gbps / max(ceil, 1e-9)
             _RESULTS.update(
@@ -1095,6 +1151,10 @@ def _bench_body(bench_dir: str) -> None:
                     "restore_assemble_span_s": spans.get(
                         "assemble", (0, 0)
                     )[0],
+                    "phase_verdict": verdict,
+                    "doctor_findings": _doctor_findings_for_spans(
+                        el, spans
+                    ),
                 }
             )
 
@@ -1120,7 +1180,7 @@ def _bench_body(bench_dir: str) -> None:
             )
             attempts.append(_timed_restore())
             _record_restore(attempts)
-        restore_elapsed, h2d_gbps, h2d_spread, restore_spans = max(
+        restore_elapsed, h2d_gbps, h2d_spread, restore_spans, _verdict = max(
             attempts, key=_ratio
         )
         restore_gbps = restored_gib / restore_elapsed

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Diff two BENCH_*.json documents; fail on throughput regression.
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json [--threshold 0.2]
+    python tools/bench_compare.py --self-test
+
+The start of a perf-trajectory gate: given the bench summary from a
+known-good run (OLD) and a candidate run (NEW), compare every
+throughput metric present in both and exit nonzero when any regressed
+by more than ``--threshold`` (default 20%). Improvements and metrics
+missing from either side never fail the gate — a cut-short run reports
+nulls, and nulls are "not measured", not "zero".
+
+Compared metrics (higher is better):
+
+- ``value`` (snapshot take GB/s)
+- ``restore_GBps``
+- ``take_vs_ceiling`` / ``restore_vs_ceiling`` (ceiling-relative
+  ratios, robust to the two runs landing on different hardware)
+
+Uncertified numbers (``restore_uncertified``/``degraded``) are compared
+but flagged in the output — a gate wired to flaky numbers should see
+the flake, not silently trust it.
+
+Exit codes: 0 = no regression; 1 = regression past the threshold;
+2 = usage/parse error.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_METRICS: List[Tuple[str, str]] = [
+    ("value", "take GB/s"),
+    ("restore_GBps", "restore GB/s"),
+    ("take_vs_ceiling", "take/ceiling"),
+    ("restore_vs_ceiling", "restore/ceiling"),
+]
+
+
+def _num(doc: Dict[str, Any], key: str) -> Optional[float]:
+    v = doc.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def unwrap(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept either a bare bench summary (what bench.py prints) or the
+    repo's BENCH_r*.json driver wrapper, whose ``tail`` string embeds
+    the summary line. Returns the summary dict; ``{}`` when the wrapper
+    holds none (e.g. a run killed before the summary)."""
+    if "metric" in doc:
+        return doc
+    tail = doc.get("tail")
+    if not isinstance(tail, str):
+        return doc
+    idx = tail.rfind('{"metric"')
+    if idx >= 0:
+        try:
+            summary, _ = json.JSONDecoder().raw_decode(tail[idx:])
+            if isinstance(summary, dict):
+                return summary
+        except json.JSONDecodeError:
+            pass
+    # The wrapper's tail can truncate the summary's head off. Scavenge
+    # the individual samples we gate on: well-formed `"key": number`
+    # pairs survive truncation everywhere except at the cut itself.
+    import re
+
+    out: Dict[str, Any] = {}
+    wanted = {k for k, _ in _METRICS} | {
+        "degraded",
+        "restore_uncertified",
+    }
+    for key in wanted:
+        hits = re.findall(
+            rf'"{re.escape(key)}": (-?\d+(?:\.\d+)?(?:e-?\d+)?|true|false|null)',
+            tail,
+        )
+        if hits:
+            # FIRST hit: the summary prints exactly once and its scalar
+            # keys precede the nested sub-bench dicts (whose own
+            # restore_GBps/take keys would otherwise shadow them).
+            raw = hits[0]
+            out[key] = (
+                None
+                if raw == "null"
+                else True
+                if raw == "true"
+                else False
+                if raw == "false"
+                else float(raw)
+            )
+    return out
+
+
+def compare(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float
+) -> Tuple[List[str], List[str]]:
+    """``(report lines, regression lines)`` — regressions nonempty means
+    the gate fails."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    for key, label in _METRICS:
+        a, b = _num(old, key), _num(new, key)
+        if a is None or b is None:
+            lines.append(
+                f"{label:18s} old={a if a is not None else '—'} "
+                f"new={b if b is not None else '—'}  (skipped: not "
+                f"measured on both sides)"
+            )
+            continue
+        if a <= 0:
+            lines.append(
+                f"{label:18s} old={a:g} new={b:g}  (skipped: "
+                f"non-positive baseline)"
+            )
+            continue
+        change = (b - a) / a
+        verdict = "ok"
+        if change < -threshold:
+            verdict = "REGRESSION"
+            regressions.append(
+                f"{label}: {a:g} -> {b:g} ({100 * change:+.1f}% vs "
+                f"-{100 * threshold:.0f}% allowed)"
+            )
+        lines.append(
+            f"{label:18s} old={a:<10g} new={b:<10g} "
+            f"{100 * change:+7.1f}%  {verdict}"
+        )
+    for flag in ("degraded", "restore_uncertified"):
+        if new.get(flag):
+            lines.append(
+                f"note: NEW run has {flag}=true — its numbers are "
+                f"not certified; treat this comparison accordingly"
+            )
+    verdicts = (
+        (old.get("phase_verdict") or {}).get("dominant_phase"),
+        (new.get("phase_verdict") or {}).get("dominant_phase"),
+    )
+    if verdicts[0] != verdicts[1] and any(verdicts):
+        lines.append(
+            f"note: dominant restore phase changed: "
+            f"{verdicts[0] or '—'} -> {verdicts[1] or '—'}"
+        )
+    return lines, regressions
+
+
+def _self_test() -> int:
+    """Built-in fixture check so CI can smoke the gate with no bench
+    run: a clean pair passes, a 30% take regression fails, and nulls
+    are skipped without failing."""
+    base = {
+        "value": 1.0,
+        "restore_GBps": 2.0,
+        "take_vs_ceiling": 0.8,
+        "restore_vs_ceiling": 0.5,
+    }
+    ok, reg = compare(base, dict(base), 0.2)
+    assert not reg, f"identical runs must pass: {reg}"
+    _, reg = compare(base, dict(base, value=0.7), 0.2)
+    assert reg and "take GB/s" in reg[0], f"30% drop must fail: {reg}"
+    _, reg = compare(base, dict(base, value=0.85), 0.2)
+    assert not reg, f"15% drop is within the 20% threshold: {reg}"
+    _, reg = compare(base, dict(base, restore_GBps=None), 0.2)
+    assert not reg, f"missing metric must be skipped, not failed: {reg}"
+    _, reg = compare({"value": None}, {"value": 1.0}, 0.2)
+    assert not reg, "null baseline must be skipped"
+    lines, _ = compare(
+        dict(base, phase_verdict={"dominant_phase": "read"}),
+        dict(
+            base,
+            restore_uncertified=True,
+            phase_verdict={"dominant_phase": "consume"},
+        ),
+        0.2,
+    )
+    joined = "\n".join(lines)
+    assert "restore_uncertified" in joined and "read -> consume" in joined
+    print("bench_compare self-test OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/bench_compare.py",
+        description="Diff two BENCH_*.json summaries; exit nonzero on "
+        "throughput regression past the threshold.",
+    )
+    parser.add_argument("old", nargs="?", help="baseline BENCH json")
+    parser.add_argument("new", nargs="?", help="candidate BENCH json")
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.2,
+        help="allowed fractional regression (default 0.2 = 20%%)",
+    )
+    parser.add_argument(
+        "--self-test",
+        action="store_true",
+        help="run the built-in fixture checks and exit",
+    )
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.old or not args.new:
+        parser.error("OLD and NEW json paths are required")
+    try:
+        with open(args.old) as f:
+            old = unwrap(json.load(f))
+        with open(args.new) as f:
+            new = unwrap(json.load(f))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    lines, regressions = compare(old, new, args.threshold)
+    for line in lines:
+        print(line)
+    if regressions:
+        print(
+            f"\nFAIL: {len(regressions)} throughput regression(s) past "
+            f"{100 * args.threshold:.0f}%:",
+            file=sys.stderr,
+        )
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print("\nOK: no throughput regression past the threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import telemetry
+from . import telemetry, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -340,6 +340,9 @@ class StoreCoordinator(Coordinator):
         self._store = store
         self._rank = rank
         self._world = world_size
+        # Stamp the trace identity the moment a rank is known, so every
+        # trace this process flushes is mergeable (telemetry/merge.py).
+        tracing.set_identity(rank=rank)
         self._gen = 0
         self._timeout_s = timeout_s
         # (generation, key) for every key this rank wrote and has not yet
@@ -468,6 +471,11 @@ class StoreCoordinator(Coordinator):
             telemetry.record_coord_wait(
                 "barrier", time.monotonic() - wait_t0
             )
+        # Barrier-exit instant: every rank passes this point only after
+        # the LAST rank arrived, so across ranks the same generation's
+        # instants mark (approximately) one global wall-clock moment —
+        # the clock-skew anchors telemetry/merge.py aligns traces with.
+        tracing.instant("barrier_exit", gen=gen)
         self._gc_through(gen)
 
     def all_gather_object(self, obj: Any) -> List[Any]:

@@ -173,6 +173,15 @@ def main(argv=None) -> int:
         "the report write failed)",
     )
     parser.add_argument(
+        "--doctor",
+        action="store_true",
+        help="run the telemetry doctor over the snapshot's flight "
+        "report(s): structured anomaly findings (consume-dominated "
+        "restore, budget stall, retry storm, straggler rank, "
+        "imbalanced stripe) with evidence and remediation hints; exit "
+        "0 healthy, 1 findings, 2 no report to diagnose",
+    )
+    parser.add_argument(
         "--diff",
         metavar="OLDER",
         help="content-diff PATH against the OLDER snapshot: which "
@@ -193,15 +202,29 @@ def main(argv=None) -> int:
         bool(args.copy_to),
         bool(args.diff),
         bool(args.report),
+        bool(args.doctor),
     ]
     if sum(exclusive) > 1:
         parser.error(
             "--verify, --delete/--sweep, --convert-back, --steps, "
-            "--reconcile, --copy-to, --diff, and --report are mutually "
-            "exclusive; run them in separate invocations"
+            "--reconcile, --copy-to, --diff, --report, and --doctor "
+            "are mutually exclusive; run them in separate invocations"
         )
     if args.report:
         return _print_reports(args.path)
+    if args.doctor:
+        from .telemetry import doctor as _doctor
+
+        reports = _doctor._collect_snapshot_reports(args.path)
+        if not reports:
+            print(
+                f"no flight report at {args.path} to diagnose",
+                file=sys.stderr,
+            )
+            return 2
+        findings = _doctor.diagnose(reports)
+        print(_doctor.render_findings(findings))
+        return 1 if findings else 0
     if args.diff:
         result = Snapshot(args.path).diff(args.diff, rank=args.rank)
         for kind in ("added", "removed", "changed", "unknown"):

@@ -287,6 +287,7 @@ class CheckpointManager:
                 )
             )
             self._clean_torn_control_files(storage)
+            self._clean_progress_debris(storage, objs)
             return handled
         finally:
             storage.close()
@@ -339,6 +340,34 @@ class CheckpointManager:
                 reclaimed.append(step)
         return reclaimed
 
+    def _sweep_aged_objects(self, storage: Any, objs, what: str) -> None:
+        """Shared body of reconcile's debris sweeps: delete each object,
+        individually protected by the ``TPUSNAPSHOT_SWEEP_MIN_AGE_S``
+        guard (unknown age and failed probes both fail CLOSED — the
+        object may belong to an in-flight take)."""
+        min_age_s = env_float("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600.0)
+        for obj in objs:
+            if min_age_s > 0:
+                try:
+                    age = asyncio.run(storage.object_age_s(obj))
+                except Exception as e:
+                    logger.warning(
+                        f"reconcile: sparing {what} {obj} "
+                        f"(age probe failed: {e!r})"
+                    )
+                    continue
+                if age is None or age < min_age_s:
+                    continue
+            try:
+                asyncio.run(storage.delete(obj))
+                logger.info(f"reconcile: removed {what} {obj}")
+            except Exception as e:
+                if not is_not_found_error(e):
+                    logger.warning(
+                        f"reconcile: removing {what} {obj} "
+                        f"failed ({e!r})"
+                    )
+
     def _clean_torn_control_files(self, storage: Any) -> None:
         """Remove ``<n>.tmp<pid>`` debris under ``.steps/``/``.pruning/``
         — a crash between the fs plugin's tmp-write and rename sub-steps
@@ -347,35 +376,30 @@ class CheckpointManager:
         Age-guarded like every sweep."""
         import re
 
-        min_age_s = env_float("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600.0)
+        doomed = []
         for prefix in (_STEP_PREFIX, _PRUNING_PREFIX):
             for obj in asyncio.run(storage.list_prefix(prefix)) or []:
-                tail = obj[len(prefix):]
-                if not re.fullmatch(r"\d+\.tmp\d+", tail):
-                    continue
-                if min_age_s > 0:
-                    try:
-                        age = asyncio.run(storage.object_age_s(obj))
-                    except Exception as e:
-                        logger.warning(
-                            f"reconcile: sparing torn control file {obj} "
-                            f"(age probe failed: {e!r})"
-                        )
-                        continue
-                    # Unknown age fails closed, same as every sweep guard.
-                    if age is None or age < min_age_s:
-                        continue
-                try:
-                    asyncio.run(storage.delete(obj))
-                    logger.info(
-                        f"reconcile: removed torn control file {obj}"
-                    )
-                except Exception as e:
-                    if not is_not_found_error(e):
-                        logger.warning(
-                            f"reconcile: removing torn control file "
-                            f"{obj} failed ({e!r})"
-                        )
+                if re.fullmatch(r"\d+\.tmp\d+", obj[len(prefix):]):
+                    doomed.append(obj)
+        self._sweep_aged_objects(storage, doomed, "torn control file")
+
+    def _clean_progress_debris(self, storage: Any, objs) -> None:
+        """Reclaim orphaned ``step-<N>/.progress/<take_id>/<rank>``
+        records from crashed takes (same convention as the ``.report/``
+        per-rank summaries: rank 0 deletes them at commit, so any
+        survivor belongs to a take that died mid-drain — or to one still
+        in flight, which the age guard protects). An uncommitted step's
+        sweep reclaims them too; this pass additionally covers COMMITTED
+        steps whose post-commit cleanup lost a race with a crash, which
+        no sweep would ever revisit."""
+        import re
+
+        pat = re.compile(r"^step-\d+/\.progress/")
+        self._sweep_aged_objects(
+            storage,
+            [obj for obj in objs if pat.match(obj)],
+            "orphaned progress record",
+        )
 
     # -------------------------------------------------------------- save
 

@@ -96,16 +96,30 @@ def _memory_budget_for_local_world(local_world_size: int) -> int:
 
 
 def _observe_op(
-    ops: Dict[str, Dict[str, Any]], op: str, seconds: float, nbytes: int
+    ops: Dict[str, Dict[str, Any]],
+    op: str,
+    seconds: float,
+    nbytes: int,
+    progress: Optional[Any] = None,
+    progress_bytes: int = 0,
 ) -> None:
     """Record one pipelined op in the always-on metrics AND the per-call
     aggregate (the flight recorder's exact per-operation numbers). Only
-    ever called from the event-loop thread, so the plain dict is safe."""
+    ever called from the event-loop thread, so the plain dict is safe.
+    ``progress`` (a telemetry ProgressPublisher) gets the same pulse —
+    its heartbeat beats exactly as often as the pipeline completes
+    work, which is what makes a stale heartbeat mean "stuck".
+    ``progress_bytes`` is this op's credit against the announced
+    bytes_total — in cost units, NOT stored-payload bytes (``nbytes``),
+    which diverge under compression; ops that re-describe payloads a
+    sibling op already credited pass 0."""
     telemetry.record_scheduler_op(op, seconds, nbytes)
     agg = ops.setdefault(op, {"count": 0, "seconds": 0.0, "bytes": 0})
     agg["count"] += 1
     agg["seconds"] += seconds
     agg["bytes"] += nbytes
+    if progress is not None:
+        progress.pipeline_update(op, progress_bytes)
 
 
 def _merge_stats(
@@ -146,15 +160,33 @@ async def execute_write_reqs(
     memory_budget_bytes: int,
     rank: int,
     stats: Optional[Dict[str, Any]] = None,
+    progress: Optional[Any] = None,
 ) -> int:
     """Run the staged-write pipeline; returns total bytes written.
 
     ``stats`` (optional) accumulates this run's exact aggregates —
     bytes, per-op count/seconds/bytes, budget stall seconds, budget
     high-water — for the flight recorder; the same numbers also feed the
-    always-on process metrics.
+    always-on process metrics. ``progress`` (optional ProgressPublisher)
+    is pulsed per op completion and cadence-published from this loop,
+    so watchers see live bytes/phase while the pipeline runs.
     """
     begin_ts = time.monotonic()
+    if progress is not None:
+        # Pre-staged buffers charge a 0 budget cost but advertise their
+        # real size via payload_nbytes — progress totals want bytes to
+        # move, not budget to charge.
+        progress.add_bytes_total(
+            sum(
+                getattr(wr.buffer_stager, "payload_nbytes", None)
+                or wr.buffer_stager.get_staging_cost_bytes()
+                for wr in write_reqs
+            )
+        )
+        # Announce the totals immediately: a pipeline that then blocks
+        # on its first storage op still leaves watchers a record with
+        # bytes_total (0 done), not a blank.
+        await progress.async_tick(force=True)
     pending = deque(write_reqs)
     staged: deque = deque()  # (WriteReq, buf)
     staging: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
@@ -184,7 +216,11 @@ async def execute_write_reqs(
                         with tracing.span("stage", path=wr.path, bytes=cost):
                             buf = await wr.buffer_stager.stage_buffer(executor)
                         _observe_op(
-                            ops, "stage", time.monotonic() - t0, len(buf)
+                            ops,
+                            "stage",
+                            time.monotonic() - t0,
+                            len(buf),
+                            progress,
                         )
                         return buf
 
@@ -197,12 +233,28 @@ async def execute_write_reqs(
             while staged and len(io_tasks) < max_io:
                 wr, buf = staged.popleft()
                 io_req = IOReq(path=wr.path, data=buf)
+                # Progress credit in the SAME units bytes_total summed
+                # (cost / payload_nbytes, pre-compression) — len(buf)
+                # is post-compression and would stall the % short.
+                share = (
+                    getattr(wr.buffer_stager, "payload_nbytes", None)
+                    or wr.buffer_stager.get_staging_cost_bytes()
+                )
 
-                async def _write(io_req=io_req, path=wr.path, n=len(buf)):
+                async def _write(
+                    io_req=io_req, path=wr.path, n=len(buf), share=share
+                ):
                     t0 = time.monotonic()
                     with tracing.span("write", path=path, bytes=n):
                         await storage.write(io_req)
-                    _observe_op(ops, "write", time.monotonic() - t0, n)
+                    _observe_op(
+                        ops,
+                        "write",
+                        time.monotonic() - t0,
+                        n,
+                        progress,
+                        progress_bytes=share,
+                    )
 
                 task = asyncio.ensure_future(_write())
                 io_tasks[task] = len(buf)
@@ -229,6 +281,8 @@ async def execute_write_reqs(
                     task.result()  # propagate storage errors
                     budget += buf_len
                     bytes_written += buf_len
+            if progress is not None:
+                await progress.async_tick()
     finally:
         executor.shutdown(wait=False)
     elapsed = time.monotonic() - begin_ts
@@ -280,6 +334,7 @@ async def execute_read_reqs(
     rank: int,
     device_budget_bytes: Optional[int] = None,
     stats: Optional[Dict[str, Any]] = None,
+    progress: Optional[Any] = None,
 ) -> int:
     """Run the read→consume pipeline; returns total bytes read.
 
@@ -297,6 +352,15 @@ async def execute_read_reqs(
     min_budget = memory_budget_bytes
     stall_s = 0.0
     ops: Dict[str, Dict[str, Any]] = {}
+    if progress is not None:
+        progress.add_bytes_total(
+            sum(
+                r.buffer_consumer.get_consuming_cost_bytes()
+                - r.buffer_consumer.get_deferred_cost_bytes()
+                for r in read_reqs
+            )
+        )
+        await progress.async_tick(force=True)
 
     # Largest LOGICAL objects first: a big object issued last would gate
     # the restore's tail all alone after the small reads drain (VERDICT
@@ -339,7 +403,11 @@ async def execute_read_reqs(
                         consumer.set_cost_releaser(budget.release)
                     io_req = IOReq(path=rr.path, byte_range=rr.byte_range)
 
-                    async def _read(io_req=io_req, path=rr.path) -> IOReq:
+                    async def _read(
+                        io_req=io_req,
+                        path=rr.path,
+                        share=cost - deferred,
+                    ) -> IOReq:
                         t0 = time.monotonic()
                         with tracing.span("read", path=path):
                             await storage.read(io_req)
@@ -348,6 +416,10 @@ async def execute_read_reqs(
                             "read",
                             time.monotonic() - t0,
                             len(io_payload(io_req)),
+                            progress,
+                            # Credit the same cost units bytes_total
+                            # summed (consuming cost minus deferred).
+                            progress_bytes=share,
                         )
                         return io_req
 
@@ -395,7 +467,11 @@ async def execute_read_reqs(
                     with tracing.span("consume", path=rr.path, bytes=len(buf)):
                         await rr.buffer_consumer.consume_buffer(buf, executor)
                     _observe_op(
-                        ops, "consume", time.monotonic() - t0, len(buf)
+                        ops,
+                        "consume",
+                        time.monotonic() - t0,
+                        len(buf),
+                        progress,
                     )
 
                 consume_task = asyncio.ensure_future(_consume())
@@ -420,6 +496,8 @@ async def execute_read_reqs(
                     cost = consuming.pop(task)
                     task.result()  # propagate consume errors
                     budget.release(cost)
+            if progress is not None:
+                await progress.async_tick()
     finally:
         executor.shutdown(wait=False)
     elapsed = time.monotonic() - begin_ts

@@ -52,6 +52,7 @@ from . import telemetry, tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .telemetry import export as telemetry_export
 from .telemetry import metrics as _metric_names
+from .telemetry import progress as liveprog
 from .telemetry import report as flight
 from .flatten import flatten, inflate
 from .io_preparer import (
@@ -286,10 +287,23 @@ class Snapshot:
             path=path,
             rank=rank,
         )
+        # Live progress record (telemetry/progress.py): phase + bytes +
+        # heartbeat on a cadence, to the local statusfile and — on the
+        # async route, once the take_id nonce exists — to
+        # .progress/<take_id>/<rank> storage objects for `watch`.
+        # Observability only, like the recorder: best-effort throughout.
+        tracing.set_identity(rank=rank)
+        watch = liveprog.ProgressPublisher(
+            kind=recorder.kind,
+            path=path,
+            rank=rank,
+            world_size=coordinator.get_world_size(),
+        )
         telemetry.counter(
             _metric_names.TAKES_TOTAL,
             mode="sync" if background is None else "async",
         ).inc()
+        watch.set_phase("capture")
         capture_t0 = time.monotonic()
 
         manifest: Manifest = {}
@@ -352,6 +366,7 @@ class Snapshot:
         if base_path is not None or fingerprint_enabled:
             from .incremental import apply_incremental
 
+            watch.set_phase("incremental")
             with recorder.phase("incremental"), tracing.span(
                 "Snapshot.incremental", path=path
             ):
@@ -379,6 +394,7 @@ class Snapshot:
 
         if background is None:
             write_stats: Dict[str, Any] = {}
+            watch.set_phase("write")
             with recorder.phase("write"):
                 asyncio.run(
                     execute_write_reqs(
@@ -387,9 +403,11 @@ class Snapshot:
                         budget,
                         rank,
                         stats=write_stats,
+                        progress=watch,
                     )
                 )
             recorder.note_pipeline(write_stats)
+            watch.set_phase("commit")
             # Route the manifest transport by size. The decision must be
             # identical on every rank (divergent routes deadlock: some
             # ranks would block in the KV all-gather, others in marker
@@ -486,6 +504,7 @@ class Snapshot:
             # exceed the coordinator's default store timeout at scale, so
             # the barrier must wait at least as long (ADVICE r3).
             barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
+            watch.finish()
             flight.local_export(recorder)
         else:
             # Async take. All *collectives* run in the foreground (they are
@@ -501,6 +520,7 @@ class Snapshot:
             # Holding the caller's device arrays lazily would break under
             # jit buffer donation (the next training step deletes the
             # snapshotted buffers).
+            watch.set_phase("prestage")
             with recorder.phase("prestage"):
                 _prestage_write_reqs(
                     pending_write_reqs,
@@ -518,10 +538,17 @@ class Snapshot:
             )
             background.take_id = nonce
             world_size = coordinator.get_world_size()
+            # From here the nonce exists, so live progress can ride the
+            # snapshot's own storage — the transport `watch <path>`
+            # reads from any machine. Published from the drain's event
+            # loop on the statusfile cadence.
+            watch.attach_storage(storage, nonce)
 
             def _drain() -> None:
                 async def _run() -> None:
                     background.phase = "storage writes"
+                    watch.set_phase("write")
+                    await watch.async_tick(force=True)
                     write_stats: Dict[str, Any] = {}
                     drain_t0 = time.monotonic()
                     await execute_write_reqs(
@@ -530,12 +557,15 @@ class Snapshot:
                         budget,
                         rank,
                         stats=write_stats,
+                        progress=watch,
                     )
                     recorder.add_phase(
                         "write", time.monotonic() - drain_t0
                     )
                     recorder.note_pipeline(write_stats)
                     background.phase = "commit markers"
+                    watch.set_phase("commit")
+                    await watch.async_tick(force=True)
                     # The completion marker carries this rank's local
                     # manifest. It must be serialized *after* this rank's
                     # writes finish: staging back-patches payload checksums
@@ -552,10 +582,12 @@ class Snapshot:
                         rank_summary=recorder.rank_summary(),
                         kind="async_take",
                         snapshot_path=path,
+                        progress=watch,
                     )
                     recorder.add_phase(
                         "commit", time.monotonic() - commit_t0
                     )
+                    watch.finish()
                     flight.local_export(recorder)
 
                 asyncio.run(_run())
@@ -621,6 +653,14 @@ class Snapshot:
         recorder = flight.FlightRecorder(
             kind="restore", path=self.path, rank=rank
         )
+        tracing.set_identity(rank=rank)
+        watch = liveprog.ProgressPublisher(
+            kind="restore",
+            path=self.path,
+            rank=rank,
+            world_size=coordinator.get_world_size(),
+        )
+        watch.set_phase("restore")
         telemetry.counter(_metric_names.RESTORES_TOTAL).inc()
         read_stats: Dict[str, Any] = {}
 
@@ -646,6 +686,7 @@ class Snapshot:
                     path_globs=paths,
                     verify_jobs_out=verify_jobs if verify_device else None,
                     stats=read_stats,
+                    progress=watch,
                 )
             coordinator.barrier()
 
@@ -664,7 +705,9 @@ class Snapshot:
                 path_globs=paths,
                 verify_jobs_out=verify_jobs if verify_device else None,
                 stats=read_stats,
+                progress=watch,
             )
+        watch.finish()
         self._finish_restore_report(
             recorder, read_stats, storage, rank, coordinator.get_world_size()
         )
@@ -841,6 +884,14 @@ class Snapshot:
             )
             if own_reports:
                 markers = markers + list(own_reports)
+            # In-flight progress records (.progress/<take_id>/<rank>) —
+            # normally cleaned at commit, but a take that died mid-drain
+            # leaves them; they go with the snapshot like the reports.
+            own_progress = asyncio.run(
+                storage.list_prefix(liveprog.PROGRESS_PREFIX)
+            )
+            if own_progress:
+                markers = markers + list(own_progress)
 
             async def _delete_all() -> None:
                 # Uncommit first; then payload deletes are order-
@@ -2261,6 +2312,12 @@ class _PreStagedStager:
         # the drain (concurrency stays bounded by the IO cap).
         return 0
 
+    @property
+    def payload_nbytes(self) -> int:
+        # The budget cost above is deliberately 0; progress totals still
+        # want the real payload size (scheduler's bytes_total sum).
+        return len(self._buf)
+
 
 def _load_stateful(
     key: str,
@@ -2274,6 +2331,7 @@ def _load_stateful(
     path_globs: Optional[List[str]] = None,
     verify_jobs_out: Optional[List[Tuple[str, Entry, Any]]] = None,
     stats: Optional[Dict[str, Any]] = None,
+    progress: Optional[Any] = None,
 ) -> int:
     """Returns the number of leaves restored (callers detect no-op filters)."""
     # In-place restore strategy (reference snapshot.py:374-381): the
@@ -2326,6 +2384,7 @@ def _load_stateful(
             rank,
             device_budget_bytes=get_device_restore_budget_bytes(),
             stats=stats,
+            progress=progress,
         )
     )
     assemble_t0 = time.monotonic()
@@ -2659,6 +2718,7 @@ async def _acommit_via_storage(
     rank_summary: Optional[Dict[str, Any]] = None,
     kind: str = "take",
     snapshot_path: str = "",
+    progress: Optional[Any] = None,
 ) -> Optional[SnapshotMetadata]:
     """Commit by completion markers: every rank writes its local manifest
     to ``.completed/<take_id>/<rank>``; rank 0 polls all markers, merges,
@@ -2687,6 +2747,15 @@ async def _acommit_via_storage(
                 rank,
                 e,
             )
+    if progress is not None and rank != 0:
+        # Terminal progress record BEFORE the completion marker: rank 0
+        # sweeps every .progress/<take_id>/* object after the markers
+        # are collected, so publish-before-marker makes "no progress
+        # object survives a commit" race-free (nothing republishes after
+        # its marker exists). Rank 0 keeps its live "commit" record
+        # while it polls — a stalled collection SHOULD read as stale.
+        progress.finish()
+        await progress.async_tick(force=True)
     marker = IOReq(path=f".completed/{take_id}/{rank}")
     marker.buf.write(
         _encode_metadata_doc(
@@ -2712,6 +2781,22 @@ async def _acommit_via_storage(
             base_paths=list(base_paths or []),
         )
         await _awrite_snapshot_metadata(storage, metadata)
+        # Progress objects are cleaned AT commit, and this sweep is the
+        # ONLY deletion path: every rank's writes finished (their
+        # markers were just collected), so the records describe an
+        # operation that no longer exists. Ranks never delete their own
+        # record — they publish a terminal "done" record before their
+        # marker instead, so the sweep cannot race a republish. If
+        # rank 0 dies before this point the take never commits and
+        # reconcile reclaims the records. Gated on the publisher having
+        # attached storage (the async route): the sync marker route
+        # never writes progress objects, and blind-deleting world_size
+        # absent objects would add O(world) storage round-trips to
+        # every large-manifest sync commit.
+        if progress is not None:
+            await liveprog.acleanup_progress_objects(
+                storage, take_id, world_size
+            )
         for r in range(world_size):
             try:
                 await storage.delete(f".completed/{take_id}/{r}")

@@ -19,6 +19,15 @@ Three layers, smallest first:
 - **Trace analytics** (:mod:`.summarize`) —
   ``python -m torchsnapshot_tpu.telemetry.summarize <trace.json>`` folds
   a Chrome trace into a per-phase table and names the dominant phase.
+- **Live progress / snapwatch** (:mod:`.progress`, :mod:`.watch`) —
+  in-flight per-rank progress records (phase, bytes, heartbeat) to a
+  local statusfile and ``.progress/<take_id>/<rank>`` storage objects;
+  ``python -m torchsnapshot_tpu.telemetry.watch <path>`` renders them
+  and flags stale-heartbeat stragglers.
+- **Cross-rank merge** (:mod:`.merge`) — N per-rank traces onto one
+  skew-corrected clock, with the cross-rank critical path.
+- **Doctor** (:mod:`.doctor`) — structured anomaly findings (rule id +
+  evidence + remediation) from flight reports; ``inspect --doctor``.
 
 NOTE: :mod:`.report` is deliberately NOT imported here — it depends on
 ``io_types``, which itself records metrics through this package; keeping

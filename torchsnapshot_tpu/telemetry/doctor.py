@@ -1,0 +1,515 @@
+"""Anomaly-diagnosing doctor: structured findings from a rule table.
+
+Usage::
+
+    python -m torchsnapshot_tpu.telemetry.doctor <snapshot-path> [--json]
+    python -m torchsnapshot_tpu.telemetry.doctor report.json [--json]
+    python -m torchsnapshot_tpu.inspect <snapshot-path> --doctor
+
+The doctor consumes a flight report (the ``.report.json`` /
+``.report.restore.rank<N>.json`` documents the recorder commits beside
+the manifest — or any JSON file of that schema) plus, optionally, a
+trace summary and a metric snapshot, and emits findings from the rule
+catalog below. Each finding names its rule id, the evidence that
+triggered it, and a remediation hint — the difference between "this
+restore was slow" and "this restore spent 176s deserializing against
+0.8s of reads; storage is innocent" (the BENCH_r05 pathology that
+motivated the whole telemetry subsystem).
+
+Rule catalog (docs/OBSERVABILITY.md carries the narrative version):
+
+========================  =============================================
+id                        trigger
+========================  =============================================
+consume-dominated-restore consume phase >= 3x the read phase
+read-dominated-restore    read phase >= 3x the consume phase
+stage-dominated-take      stage busy >= 3x write busy (scheduler ops)
+budget-stall-dominated    budget stall >= 25% of a rank's wall time
+retry-storm               storage retries >= 10 across the operation
+straggler-rank            a rank's wall >= 1.5x the rank median (>2s)
+imbalanced-stripe         max rank bytes >= 2x the rank median
+missing-rank-summary      a rank's summary never arrived (null)
+========================  =============================================
+
+Findings are observability, not judgment: every rule errs toward
+silence on thin evidence (tiny operations trip no ratios).
+
+Exit codes: 0 = healthy (no findings); 1 = findings emitted;
+2 = usage / no report found.
+"""
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# Ratio thresholds, shared with summarize's dominance verdict where the
+# same question is asked of a trace instead of a report.
+_DOMINANCE_RATIO = 3.0
+_STALL_FRACTION = 0.25
+_RETRY_STORM_COUNT = 10
+_STRAGGLER_RATIO = 1.5
+_STRAGGLER_MIN_WALL_S = 2.0
+_STRIPE_RATIO = 2.0
+# Phases must clear this floor before a ratio means anything: a 0.05s
+# consume "dominating" a 0.006s read is scheduler jitter on a tiny
+# operation, not a pathology worth a remediation hint — the findings
+# this doctor exists for are seconds-to-minutes (BENCH_r05: 176s).
+_MIN_PHASE_S = 1.0
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str  # "warn" | "critical"
+    title: str
+    evidence: Dict[str, Any] = field(default_factory=dict)
+    remediation: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "title": self.title,
+            "evidence": self.evidence,
+            "remediation": self.remediation,
+        }
+
+
+def _ranks(report: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [s for s in (report.get("ranks") or []) if s]
+
+
+def _phase_s(summary: Dict[str, Any], phase: str) -> float:
+    return float((summary.get("phases") or {}).get(f"{phase}_s", 0.0))
+
+
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    return ordered[len(ordered) // 2] if ordered else 0.0
+
+
+# ----------------------------------------------------------------- the rules
+#
+# Each rule: (report) -> Optional[Finding]. Rules see the whole merged
+# report so cross-rank rules (straggler, stripe) need no special casing.
+
+
+def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
+    if report.get("kind") != "restore":
+        return None
+    consume = sum(_phase_s(s, "consume") for s in _ranks(report))
+    read = sum(_phase_s(s, "read") for s in _ranks(report))
+    if consume < _MIN_PHASE_S or consume < _DOMINANCE_RATIO * max(
+        read, 1e-9
+    ):
+        return None
+    return Finding(
+        rule="consume-dominated-restore",
+        severity="critical",
+        title=(
+            f"restore spent {consume:.2f}s deserializing / placing "
+            f"against {read:.2f}s of storage reads"
+        ),
+        evidence={
+            "consume_s": round(consume, 3),
+            "read_s": round(read, 3),
+            "ratio": round(consume / max(read, 1e-9), 1),
+        },
+        remediation=(
+            "storage is innocent — the bottleneck is host-side "
+            "deserialization / host->device placement. Check "
+            "compression settings (zlib inflate is single-threaded "
+            "per buffer), raise the device restore budget "
+            "(TPUSNAPSHOT_DEVICE_RESTORE_BUDGET_BYTES), and confirm "
+            "consumes overlap reads in the trace (summarize's overlap "
+            "column)."
+        ),
+    )
+
+
+def _rule_read_dominated(report: Dict[str, Any]) -> Optional[Finding]:
+    if report.get("kind") != "restore":
+        return None
+    consume = sum(_phase_s(s, "consume") for s in _ranks(report))
+    read = sum(_phase_s(s, "read") for s in _ranks(report))
+    if read < _MIN_PHASE_S or read < _DOMINANCE_RATIO * max(consume, 1e-9):
+        return None
+    return Finding(
+        rule="read-dominated-restore",
+        severity="warn",
+        title=(
+            f"restore spent {read:.2f}s in storage reads against "
+            f"{consume:.2f}s of consumes"
+        ),
+        evidence={
+            "read_s": round(read, 3),
+            "consume_s": round(consume, 3),
+            "ratio": round(read / max(consume, 1e-9), 1),
+        },
+        remediation=(
+            "storage read bandwidth is the bottleneck: check the "
+            "backend's read concurrency cap, object sizes (many tiny "
+            "objects pay per-request latency), and network egress "
+            "limits."
+        ),
+    )
+
+
+def _rule_stage_dominated(report: Dict[str, Any]) -> Optional[Finding]:
+    if report.get("kind") not in ("take", "async_take"):
+        return None
+    stage = sum(
+        float((s.get("scheduler_ops") or {}).get("stage", {}).get("seconds", 0.0))
+        for s in _ranks(report)
+    )
+    write = sum(
+        float((s.get("scheduler_ops") or {}).get("write", {}).get("seconds", 0.0))
+        for s in _ranks(report)
+    )
+    if stage < _MIN_PHASE_S or stage < _DOMINANCE_RATIO * max(write, 1e-9):
+        return None
+    return Finding(
+        rule="stage-dominated-take",
+        severity="warn",
+        title=(
+            f"take spent {stage:.2f}s staging (device->host + "
+            f"serialize) against {write:.2f}s of storage writes"
+        ),
+        evidence={
+            "stage_s": round(stage, 3),
+            "write_s": round(write, 3),
+            "ratio": round(stage / max(write, 1e-9), 1),
+        },
+        remediation=(
+            "device->host transfer / serialization is the bottleneck, "
+            "not storage. Check compression cost, host CPU "
+            "contention with the training step, and whether "
+            "incremental takes (base=) could skip unchanged arrays."
+        ),
+    )
+
+
+def _rule_budget_stall(report: Dict[str, Any]) -> Optional[Finding]:
+    worst: Optional[Dict[str, Any]] = None
+    for s in _ranks(report):
+        wall = float(s.get("wall_s") or 0.0)
+        stall = float((s.get("budget") or {}).get("stall_s", 0.0))
+        if wall < 1.0 or stall < _STALL_FRACTION * wall:
+            continue
+        if worst is None or stall > worst["stall_s"]:
+            worst = {
+                "rank": s.get("rank"),
+                "stall_s": round(stall, 3),
+                "wall_s": round(wall, 3),
+                "fraction": round(stall / wall, 2),
+                "high_water_bytes": (s.get("budget") or {}).get(
+                    "high_water_bytes", 0
+                ),
+            }
+    if worst is None:
+        return None
+    return Finding(
+        rule="budget-stall-dominated",
+        severity="warn",
+        title=(
+            f"rank {worst['rank']} spent {worst['stall_s']:.2f}s "
+            f"({100 * worst['fraction']:.0f}% of its wall time) stalled "
+            f"on the memory budget"
+        ),
+        evidence=worst,
+        remediation=(
+            "the pipeline was ready to move bytes but the per-process "
+            "memory budget said no. Raise "
+            "TPUSNAPSHOT_PER_RANK_MEMORY_BUDGET_BYTES if host RAM "
+            "allows, or reduce per-object sizes (chunked writes) so "
+            "admission granularity is finer."
+        ),
+    )
+
+
+def _rule_retry_storm(report: Dict[str, Any]) -> Optional[Finding]:
+    totals = report.get("totals") or {}
+    retries = float(totals.get("retries") or 0)
+    if retries < _RETRY_STORM_COUNT:
+        return None
+    by_rank = {
+        str(s.get("rank")): (s.get("retries") or {}).get("total", 0)
+        for s in _ranks(report)
+        if (s.get("retries") or {}).get("total", 0)
+    }
+    return Finding(
+        rule="retry-storm",
+        severity="critical",
+        title=(
+            f"{retries:g} storage retries across the operation — the "
+            f"backend is throttling or flapping"
+        ),
+        evidence={"retries": retries, "by_rank": by_rank},
+        remediation=(
+            "check the storage backend's health/quota (429s = request "
+            "rate or bandwidth quota; 503s = service brownout). The "
+            "retry budget (TPUSNAPSHOT_STORAGE_RETRY_BUDGET_S) bounds "
+            "how long each op keeps trying; fewer, larger objects "
+            "reduce request-rate pressure."
+        ),
+    )
+
+
+def _rule_straggler(report: Dict[str, Any]) -> Optional[Finding]:
+    ranks = _ranks(report)
+    if len(ranks) < 2:
+        return None
+    walls = [float(s.get("wall_s") or 0.0) for s in ranks]
+    median = _median(walls)
+    if median <= 0:
+        return None
+    worst = max(ranks, key=lambda s: float(s.get("wall_s") or 0.0))
+    wall = float(worst.get("wall_s") or 0.0)
+    if wall < _STRAGGLER_MIN_WALL_S or wall < _STRAGGLER_RATIO * median:
+        return None
+    return Finding(
+        rule="straggler-rank",
+        severity="warn",
+        title=(
+            f"rank {worst.get('rank')} took {wall:.2f}s against a "
+            f"rank-median of {median:.2f}s"
+        ),
+        evidence={
+            "rank": worst.get("rank"),
+            "wall_s": round(wall, 3),
+            "median_wall_s": round(median, 3),
+            "ratio": round(wall / median, 2),
+            "phases": worst.get("phases"),
+        },
+        remediation=(
+            "one rank gated the whole operation. Compare its phase "
+            "breakdown against the others (inspect --report): slow "
+            "storage from one host, an imbalanced stripe, or host CPU "
+            "contention. Cross-check with telemetry.merge's critical "
+            "path on per-rank traces."
+        ),
+    )
+
+
+def _rule_imbalanced_stripe(report: Dict[str, Any]) -> Optional[Finding]:
+    ranks = _ranks(report)
+    if len(ranks) < 2:
+        return None
+    sizes = [float(s.get("bytes") or 0) for s in ranks]
+    median = _median(sizes)
+    biggest = max(ranks, key=lambda s: float(s.get("bytes") or 0))
+    top = float(biggest.get("bytes") or 0)
+    if median <= 0 or top < _STRIPE_RATIO * median or top < 1 << 20:
+        return None
+    return Finding(
+        rule="imbalanced-stripe",
+        severity="warn",
+        title=(
+            f"rank {biggest.get('rank')} moved {top:.0f} bytes against "
+            f"a rank-median of {median:.0f}"
+        ),
+        evidence={
+            "rank": biggest.get("rank"),
+            "bytes": int(top),
+            "median_bytes": int(median),
+            "ratio": round(top / median, 2),
+        },
+        remediation=(
+            "byte load is skewed across ranks. For replicated values "
+            "the striper balances by size estimates — non-array values "
+            "estimate as 0 and spread by count, so one giant pickled "
+            "object can skew a rank. Shard large values, or mark them "
+            "replicated so the LPT striper can balance them."
+        ),
+    )
+
+
+def _rule_missing_summary(report: Dict[str, Any]) -> Optional[Finding]:
+    ranks = report.get("ranks") or []
+    missing = [i for i, s in enumerate(ranks) if not s]
+    if not missing or report.get("kind") == "restore":
+        # Restore reports are rank-local by design; their ranks list
+        # holds one summary regardless of world size.
+        return None
+    return Finding(
+        rule="missing-rank-summary",
+        severity="warn",
+        title=f"rank(s) {missing} contributed no flight summary",
+        evidence={"missing_ranks": missing},
+        remediation=(
+            "the operation committed but those ranks' summaries never "
+            "arrived — a crashed-and-restarted process, or a summary "
+            "write that lost its race with the commit. If it recurs, "
+            "check those hosts' logs."
+        ),
+    )
+
+
+RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
+    _rule_consume_dominated,
+    _rule_read_dominated,
+    _rule_stage_dominated,
+    _rule_budget_stall,
+    _rule_retry_storm,
+    _rule_straggler,
+    _rule_imbalanced_stripe,
+    _rule_missing_summary,
+]
+
+_SEVERITY_ORDER = {"critical": 0, "warn": 1}
+
+
+def diagnose_report(report: Dict[str, Any]) -> List[Finding]:
+    """Run the whole rule table over one flight report."""
+    findings = [f for f in (rule(report) for rule in RULES) if f]
+    findings.sort(key=lambda f: (_SEVERITY_ORDER.get(f.severity, 9), f.rule))
+    return findings
+
+
+def diagnose(
+    reports: List[Dict[str, Any]],
+    trace_summary: Optional[Dict[str, Any]] = None,
+) -> List[Finding]:
+    """Findings across several reports (a take report plus restore
+    reports, as ``inspect --doctor`` collects them), plus the trace
+    summarizer's dominance verdict when a summary is supplied and no
+    report already made the same call."""
+    findings: List[Finding] = []
+    for report in reports:
+        findings.extend(diagnose_report(report))
+    verdict = (trace_summary or {}).get("verdict")
+    if verdict and verdict.get("dominated"):
+        rule = (
+            f"{verdict['dominant_phase']}-dominated-"
+            f"{verdict['pipeline']}"
+        )
+        if not any(f.rule.startswith(verdict["dominant_phase"]) for f in findings):
+            findings.append(
+                Finding(
+                    rule=rule,
+                    severity="warn",
+                    title=(
+                        f"trace: {verdict['pipeline']} is "
+                        f"{verdict['dominant_phase']}-dominated "
+                        f"({verdict['busy_s']:.2f}s busy vs "
+                        f"{verdict['sibling']} "
+                        f"{verdict['sibling_busy_s']:.2f}s)"
+                    ),
+                    evidence=dict(verdict),
+                    remediation=(
+                        "see telemetry.summarize's advice line for this "
+                        "phase."
+                    ),
+                )
+            )
+    findings.sort(key=lambda f: (_SEVERITY_ORDER.get(f.severity, 9), f.rule))
+    return findings
+
+
+def render_findings(findings: List[Finding]) -> str:
+    if not findings:
+        return "doctor: no findings — nothing anomalous in the report(s)"
+    lines = [f"doctor: {len(findings)} finding(s)"]
+    for f in findings:
+        lines.append(f"[{f.severity.upper():8s}] {f.rule}: {f.title}")
+        if f.evidence:
+            ev = ", ".join(f"{k}={v}" for k, v in sorted(f.evidence.items()))
+            lines.append(f"           evidence: {ev}")
+        if f.remediation:
+            lines.append(f"           remediation: {f.remediation}")
+    return "\n".join(lines)
+
+
+def _collect_snapshot_reports(path: str) -> List[Dict[str, Any]]:
+    """The take report + any restore reports a snapshot holds."""
+    import asyncio
+
+    from ..storage_plugin import url_to_storage_plugin
+    from . import report as flight
+
+    storage = url_to_storage_plugin(path)
+    try:
+        reports: List[Dict[str, Any]] = []
+        take = asyncio.run(flight.aread_json(storage, flight.REPORT_FNAME))
+        if take is not None:
+            reports.append(take)
+        for p in sorted(
+            asyncio.run(storage.list_prefix(flight.REPORT_PREFIX)) or []
+        ):
+            if p.startswith(".report.restore."):
+                doc = asyncio.run(flight.aread_json(storage, p))
+                if doc is not None:
+                    reports.append(doc)
+        return reports
+    finally:
+        storage.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchsnapshot_tpu.telemetry.doctor",
+        description="Diagnose a snapshot operation's flight report(s) "
+        "against the anomaly rule table.",
+    )
+    parser.add_argument(
+        "path",
+        help="snapshot URL (reads its .report.json + restore reports) "
+        "or a path to one report JSON file",
+    )
+    parser.add_argument(
+        "--trace",
+        help="optional Chrome trace to fold for a dominance verdict",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit findings as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    import os
+
+    reports: List[Dict[str, Any]]
+    if "://" not in args.path and os.path.isfile(args.path):
+        try:
+            with open(args.path) as f:
+                reports = [json.load(f)]
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            reports = _collect_snapshot_reports(args.path)
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    if not reports:
+        print(f"no flight report at {args.path}", file=sys.stderr)
+        return 2
+
+    trace_summary = None
+    if args.trace:
+        from . import summarize as _summarize
+
+        try:
+            trace_summary = _summarize.summarize(
+                _summarize.fold_spans(_summarize.load_events(args.trace))
+            )
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    findings = diagnose(reports, trace_summary=trace_summary)
+    if args.json:
+        print(
+            json.dumps(
+                [f.as_dict() for f in findings], indent=2, sort_keys=True
+            )
+        )
+    else:
+        print(render_findings(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""Cross-rank Chrome-trace merge: one timeline, one clock, one verdict.
+
+Usage::
+
+    python -m torchsnapshot_tpu.telemetry.merge rank0.json rank1.json ... \
+        -o merged.json [--json]
+
+Each per-rank trace written by ``tracing.py`` is self-describing: its
+``metadata`` carries ``clock_epoch_s`` (the wall-clock epoch of trace
+ts 0), ``rank``, and ``host``. The merge
+
+1. maps every event's monotonic ts onto the wall clock,
+2. **corrects clock skew** using coord barrier instants
+   (``barrier_exit`` events: every rank passes a given barrier
+   generation at approximately one global moment, so per-rank deviation
+   from the cross-rank median at shared generations IS that rank's
+   clock skew),
+3. emits a single Perfetto-loadable trace — each rank rendered as its
+   own process (``pid = rank``, named ``rank N (host)``), span ids
+   namespaced per rank so cross-rank id collisions cannot pair a begin
+   on one rank with an end on another, all timestamps rebased to one
+   monotonic non-negative clock,
+4. computes the **cross-rank critical path**: which rank's pipeline
+   activity ended last (gating the commit every other rank then waited
+   for), that rank's dominant phase, and each rank's slack.
+
+``telemetry.summarize`` recognizes a merged trace and appends the
+critical-path section to its per-phase table.
+
+Exit codes: 0 = merged; 1 = no events in any input; 2 = usage error.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# The pipelined ops whose completion can gate a commit (take or restore
+# direction); instants and orchestration wrappers don't gate by
+# themselves.
+_PIPELINE_OPS = ("stage", "write", "read", "consume")
+
+_BARRIER_INSTANT = "barrier_exit"
+_COMMIT_INSTANTS = ("metadata_committed", "step_marker_committed")
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare-array Chrome trace variant
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a Chrome trace")
+    return doc
+
+
+def trace_meta(doc: Dict[str, Any], fallback_rank: int) -> Dict[str, Any]:
+    """The trace's identity metadata, tolerating traces from before the
+    stamp existed (they merge as rank ``fallback_rank`` on an
+    uncorrected clock)."""
+    meta = doc.get("metadata") or {}
+    return {
+        "clock_epoch_s": float(meta.get("clock_epoch_s") or 0.0),
+        "rank": int(meta["rank"]) if meta.get("rank") is not None else fallback_rank,
+        "host": str(meta.get("host") or "?"),
+    }
+
+
+def _barrier_walls(
+    doc: Dict[str, Any], epoch: float
+) -> Dict[Any, float]:
+    """``{barrier generation: wall time}`` for this trace's
+    barrier-exit instants (first occurrence per generation)."""
+    out: Dict[Any, float] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "i" and ev.get("name") == _BARRIER_INSTANT:
+            gen = (ev.get("args") or {}).get("gen")
+            if gen is not None and gen not in out:
+                out[gen] = epoch + ev.get("ts", 0.0) / 1e6
+    return out
+
+
+def compute_skews(
+    docs: List[Dict[str, Any]], metas: List[Dict[str, Any]]
+) -> Dict[int, float]:
+    """Per-rank clock-skew estimate (seconds to SUBTRACT from that
+    rank's wall times). Anchored on barrier generations present in every
+    trace: at each shared generation, a rank's deviation from the
+    cross-rank median is skew plus barrier-exit jitter; averaging over
+    generations keeps the jitter small. Ranks without shared anchors
+    get skew 0 (wall clocks trusted as-is)."""
+    walls = [
+        _barrier_walls(doc, meta["clock_epoch_s"])
+        for doc, meta in zip(docs, metas)
+    ]
+    shared = set(walls[0]) if walls else set()
+    for w in walls[1:]:
+        shared &= set(w)
+    skews: Dict[int, List[float]] = {}
+    for gen in shared:
+        at = sorted(w[gen] for w in walls)
+        median = at[len(at) // 2]
+        for meta, w in zip(metas, walls):
+            skews.setdefault(meta["rank"], []).append(w[gen] - median)
+    return {
+        meta["rank"]: (
+            sum(skews[meta["rank"]]) / len(skews[meta["rank"]])
+            if skews.get(meta["rank"])
+            else 0.0
+        )
+        for meta in metas
+    }
+
+
+def merge_traces(
+    docs: List[Dict[str, Any]], skew_correct: bool = True
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Merge per-rank traces onto one corrected clock.
+
+    Returns ``(merged trace doc, info)`` where info carries the skew
+    table and the critical-path verdict.
+    """
+    metas = [trace_meta(doc, i) for i, doc in enumerate(docs)]
+    ranks = [m["rank"] for m in metas]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(
+            f"duplicate rank(s) across input traces: {sorted(ranks)} — "
+            f"each input must be a distinct rank's trace"
+        )
+    skews = (
+        compute_skews(docs, metas)
+        if skew_correct
+        else {r: 0.0 for r in ranks}
+    )
+
+    # Corrected wall time of every event; the merged clock starts at the
+    # earliest event (ts >= 0, monotonic by construction: one shared
+    # wall clock after skew subtraction).
+    t_base: Optional[float] = None
+    per_doc_events: List[List[Tuple[float, Dict[str, Any]]]] = []
+    for doc, meta in zip(docs, metas):
+        epoch = meta["clock_epoch_s"] - skews[meta["rank"]]
+        rows = []
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue  # per-process metadata is re-emitted below
+            wall = epoch + ev.get("ts", 0.0) / 1e6
+            rows.append((wall, ev))
+            t_base = wall if t_base is None else min(t_base, wall)
+        per_doc_events.append(rows)
+    if t_base is None:
+        raise ValueError("no events in any input trace")
+
+    merged_events: List[Dict[str, Any]] = []
+    for meta, rows in zip(metas, per_doc_events):
+        rank = meta["rank"]
+        merged_events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": rank,
+                "tid": 0,
+                "args": {"name": f"rank {rank} ({meta['host']})"},
+            }
+        )
+        for wall, ev in rows:
+            out = dict(ev)
+            out["ts"] = (wall - t_base) * 1e6
+            out["pid"] = rank
+            if "id" in out:
+                # Namespace span ids per rank: every trace counts ids
+                # from 1, and a cross-rank collision would let a begin
+                # on rank A pair with an end on rank B.
+                out["id"] = f"r{rank}:{out['id']}"
+            merged_events.append(out)
+    merged_events.sort(key=lambda e: e.get("ts", 0.0))
+
+    info = {
+        "ranks": sorted(ranks),
+        "skew_s": {str(r): round(skews[r], 6) for r in sorted(skews)},
+        "t_base_epoch_s": t_base,
+        "critical_path": critical_path(merged_events),
+    }
+    merged = {
+        "traceEvents": merged_events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merged": True,
+            "ranks": sorted(ranks),
+            "skew_s": info["skew_s"],
+            "clock_epoch_s": t_base,
+            "tracer": "torchsnapshot_tpu",
+        },
+    }
+    return merged, info
+
+
+def critical_path(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Which rank/phase gated the commit.
+
+    Per rank, find the end time of its last pipeline-op span (the work
+    the commit's completion barrier waits for). The **gating rank** is
+    the one whose pipeline ended last; every other rank's slack is how
+    long it sat finished while the gater worked. The commit instant
+    (when present) confirms the ordering: it can only land after the
+    gating rank's last write.
+    """
+    begins: Dict[Any, Dict[str, Any]] = {}
+    last_end: Dict[int, float] = {}
+    last_phase: Dict[int, str] = {}
+    commit_ts: Optional[float] = None
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "")
+        if ph == "i" and name in _COMMIT_INSTANTS:
+            ts = ev.get("ts", 0.0)
+            commit_ts = ts if commit_ts is None else max(commit_ts, ts)
+            continue
+        if name not in _PIPELINE_OPS:
+            continue
+        if ph == "b":
+            begins[(ev.get("pid"), ev.get("id"), name)] = ev
+        elif ph == "e":
+            b = begins.pop((ev.get("pid"), ev.get("id"), name), None)
+            if b is None:
+                continue
+            rank = int(ev.get("pid", 0))
+            end = ev.get("ts", 0.0)
+            if end >= last_end.get(rank, -1.0):
+                last_end[rank] = end
+                last_phase[rank] = name
+        elif ph == "X":
+            rank = int(ev.get("pid", 0))
+            end = ev.get("ts", 0.0) + ev.get("dur", 0)
+            if end >= last_end.get(rank, -1.0):
+                last_end[rank] = end
+                last_phase[rank] = name
+    if not last_end:
+        return None
+    gating_rank = max(last_end, key=lambda r: last_end[r])
+    gate_end = last_end[gating_rank]
+    return {
+        "gating_rank": gating_rank,
+        "gating_phase": last_phase[gating_rank],
+        "gate_end_s": round(gate_end / 1e6, 6),
+        "commit_at_s": (
+            round(commit_ts / 1e6, 6) if commit_ts is not None else None
+        ),
+        "per_rank": [
+            {
+                "rank": r,
+                "last_phase": last_phase[r],
+                "last_end_s": round(last_end[r] / 1e6, 6),
+                "slack_s": round((gate_end - last_end[r]) / 1e6, 6),
+            }
+            for r in sorted(last_end)
+        ],
+    }
+
+
+def render_info(info: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"merged {len(info['ranks'])} rank trace(s): "
+        f"ranks {', '.join(str(r) for r in info['ranks'])}"
+    )
+    skews = info.get("skew_s") or {}
+    if any(abs(v) > 0 for v in skews.values()):
+        lines.append("per-rank clock skew (s, corrected):")
+        for r in sorted(skews, key=int):
+            lines.append(f"  rank {r}: {skews[r]:+.6f}")
+    else:
+        lines.append("per-rank clock skew: none detected (or no shared "
+                     "barrier anchors)")
+    cp = info.get("critical_path")
+    if cp:
+        lines.append(
+            f"critical path: rank {cp['gating_rank']} gated the commit "
+            f"(last {cp['gating_phase']} ended at "
+            f"{cp['gate_end_s']:.3f}s)"
+        )
+        for row in cp["per_rank"]:
+            lines.append(
+                f"  rank {row['rank']}: last {row['last_phase']} ended "
+                f"{row['last_end_s']:.3f}s, slack {row['slack_s']:.3f}s"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchsnapshot_tpu.telemetry.merge",
+        description="Merge per-rank snapshot traces onto one "
+        "skew-corrected clock.",
+    )
+    parser.add_argument("traces", nargs="+", help="per-rank trace JSONs")
+    parser.add_argument(
+        "-o",
+        "--output",
+        required=True,
+        help="path for the merged Perfetto-loadable trace",
+    )
+    parser.add_argument(
+        "--no-skew-correct",
+        action="store_true",
+        help="trust wall clocks as-is (skip barrier-anchor alignment)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the skew table + critical path as JSON on stdout",
+    )
+    args = parser.parse_args(argv)
+    try:
+        docs = [load_trace(p) for p in args.traces]
+        merged, info = merge_traces(
+            docs, skew_correct=not args.no_skew_correct
+        )
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        if isinstance(e, ValueError) and "no events" in str(e):
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    if args.json:
+        print(json.dumps(info, indent=2, sort_keys=True))
+    else:
+        print(render_info(info))
+        print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,12 +52,21 @@ def union_seconds(intervals: List[Tuple[float, float]]) -> float:
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
+    return load_doc(path)[0]
+
+
+def load_doc(
+    path: str,
+) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """``(events, trace metadata)`` — metadata is ``{}`` for bare-array
+    traces and traces from before the identity stamp existed."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict):
-        return doc.get("traceEvents", [])
+        meta = doc.get("metadata")
+        return doc.get("traceEvents", []), meta if isinstance(meta, dict) else {}
     if isinstance(doc, list):  # bare-array Chrome trace variant
-        return doc
+        return doc, {}
     raise ValueError(f"{path}: not a Chrome trace (dict or list expected)")
 
 
@@ -231,7 +240,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
     try:
-        events = load_events(args.trace)
+        events, meta = load_doc(args.trace)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -239,10 +248,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not summary["phases"]:
         print("no spans found", file=sys.stderr)
         return 1
+    if meta.get("merged"):
+        # A cross-rank merged trace (telemetry/merge.py): append the
+        # critical path — which rank/phase gated the commit — and the
+        # per-rank skew table the merge corrected with.
+        from .merge import critical_path
+
+        summary["cross_rank"] = {
+            "ranks": meta.get("ranks"),
+            "skew_s": meta.get("skew_s"),
+            "critical_path": critical_path(events),
+        }
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:
         print(render(summary))
+        cross = summary.get("cross_rank")
+        cp = (cross or {}).get("critical_path")
+        if cp:
+            print()
+            print(
+                f"critical path: rank {cp['gating_rank']} gated the "
+                f"commit (last {cp['gating_phase']} ended at "
+                f"{cp['gate_end_s']:.3f}s)"
+            )
+            for row in cp["per_rank"]:
+                print(
+                    f"  rank {row['rank']}: last {row['last_phase']} "
+                    f"ended {row['last_end_s']:.3f}s, slack "
+                    f"{row['slack_s']:.3f}s  "
+                    f"(clock skew "
+                    f"{(cross.get('skew_s') or {}).get(str(row['rank']), 0.0):+.6f}s)"
+                )
     return 0
 
 

@@ -32,6 +32,7 @@ import atexit
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 from contextlib import contextmanager
@@ -43,16 +44,33 @@ _lock = threading.Lock()
 _events: Optional[List[Dict[str, Any]]] = None
 _path: Optional[str] = None
 _t0: float = 0.0
+# Wall-clock epoch captured at the same instant as _t0, so any event's
+# monotonic ts maps to an absolute time: wall = _wall0 + ts/1e6. The
+# cross-rank merge (telemetry/merge.py) aligns per-rank traces on it.
+_wall0: float = 0.0
+_rank: Optional[int] = None
 _span_ids = itertools.count(1)
+
+
+def set_identity(rank: Optional[int] = None) -> None:
+    """Record this process's rank for the trace metadata. Called by the
+    snapshot paths the moment a coordinator resolves (cheap, idempotent);
+    single-rank traces default to rank 0 so every trace is
+    self-describing and mergeable."""
+    global _rank
+    if rank is not None:
+        with _lock:
+            _rank = rank
 
 
 def enable(path: str) -> None:
     """Start recording spans; ``flush()`` (or process exit) writes them."""
-    global _events, _path, _t0
+    global _events, _path, _t0, _wall0
     with _lock:
         _events = []
         _path = path
         _t0 = time.monotonic()
+        _wall0 = time.time()
 
 
 def disable() -> None:
@@ -81,7 +99,22 @@ def flush() -> Optional[str]:
     with _lock:
         if _events is None or _path is None:
             return None
-        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        payload = {
+            "traceEvents": list(_events),
+            "displayTimeUnit": "ms",
+            # Self-describing clock + identity, even for single-rank
+            # traces: the merge prerequisite. ``clock_epoch_s`` is the
+            # wall-clock epoch of trace ts 0 (events carry monotonic µs
+            # offsets from it), so N traces from N hosts can be aligned
+            # onto one timeline and skew-corrected.
+            "metadata": {
+                "clock_epoch_s": _wall0,
+                "rank": _rank if _rank is not None else 0,
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+                "tracer": "torchsnapshot_tpu",
+            },
+        }
         path = _path
     tmp = f"{path}.tmp{os.getpid()}"
     try:
