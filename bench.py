@@ -185,6 +185,17 @@ def _gate(next_work: str, need_s: float) -> None:
         )
 
 
+def _note_gap(section: str, reason: str) -> None:
+    """Record a section the run never measured (deadline/budget): the
+    summary's explicit ``gaps`` list, so timeline/bench_compare treat
+    it as MISSING data, never as zero (BENCH_r05 silently dropped whole
+    sections and the artifact read as if they didn't exist)."""
+    gaps = _RESULTS.setdefault("gaps", [])
+    if section not in gaps:
+        gaps.append(section)
+    print(f"[bench] GAP: {section} not measured ({reason})", file=sys.stderr)
+
+
 def _summary_doc() -> dict:
     """The one-line summary, built from whatever _RESULTS holds. Keys
     match the clean-run schema exactly; quantities a cut-short run never
@@ -232,6 +243,7 @@ def _summary_doc() -> dict:
         "incremental": r.get("incremental"),
         "scaling": r.get("scaling"),
         "sharded_cpu": r.get("sharded_cpu"),
+        "gaps": r.get("gaps", []),
         "degraded": bool(r.get("degraded", True) or r.get("abort")),
         "abort": r.get("abort"),
         "phase_at_exit": _PHASE[0],
@@ -417,12 +429,16 @@ def _run_cpu_subprocess_bench(script_name: str, timeout_s: float = 600.0) -> dic
         return {"ok": False, "error": repr(e)}
 
 
-def _run_stall_bench(timeout_s: float) -> dict:
+def _run_stall_bench(timeout_s: float, reduced: bool = False) -> dict:
     """Run benchmarks/in_situ_stall.py on the AMBIENT platform (the real
     chip under the driver): p50/p95 step-time inflation of a live jitted
     training loop with async_take firing mid-loop — the "<5% training
     step stall" north-star number (VERDICT r4 #8), measured against a
-    busy device rather than bench.py's idle-device stall."""
+    busy device rather than bench.py's idle-device stall.
+
+    ``reduced=True`` shrinks the loop (fewer steps, smaller model) so a
+    tight remaining budget still yields a lower-confidence number
+    instead of a skipped section (BENCH_r05)."""
     import subprocess
 
     script = os.path.join(
@@ -430,9 +446,22 @@ def _run_stall_bench(timeout_s: float) -> dict:
         "benchmarks",
         "in_situ_stall.py",
     )
+    env = dict(os.environ)
+    if reduced:
+        env.update(
+            {
+                "TPUSNAPSHOT_STALL_STEPS": "24",
+                "TPUSNAPSHOT_STALL_EVERY": "8",
+                "TPUSNAPSHOT_STALL_DMODEL": "256",
+                "TPUSNAPSHOT_STALL_LAYERS": "2",
+                "TPUSNAPSHOT_STALL_SEQ": "256",
+                "TPUSNAPSHOT_STALL_BATCH": "4",
+            }
+        )
     try:
         proc = subprocess.run(
             [sys.executable, script],
+            env=env,
             capture_output=True,
             text=True,
             timeout=max(60.0, timeout_s),
@@ -446,20 +475,38 @@ def _run_stall_bench(timeout_s: float) -> dict:
             return {"ok": False, "error": f"rc={proc.returncode}"}
         doc = json.loads(proc.stdout.strip().splitlines()[-1])
         doc["ok"] = True
+        doc["reduced"] = reduced
         return doc
     except Exception as e:
         print(f"[bench] in-situ stall bench failed: {e!r}", file=sys.stderr)
         return {"ok": False, "error": repr(e)}
 
 
-def _run_incremental_block(bench_dir: str) -> dict:
+def _run_incremental_block(
+    bench_dir: str, budget_s: float = None, est_gbps: float = None
+) -> dict:
     """Incremental-take headline (beyond parity — incremental.py): a
     fingerprinted full take vs a ``base=`` take after mutating 1 of 10
     params. Self-contained bounded payload (100 MiB) so a collapsed
     link cannot let this phase starve the ones after it; the SPEEDUP
     ratio is the certified quantity (both takes cross the same link
-    moments apart), not the absolute times."""
+    moments apart), not the absolute times.
+
+    Per-section deadline budgeting (BENCH_r05 ate this section with
+    ``"skipped: hard deadline"``): when ``budget_s``/``est_gbps`` say
+    the full 100 MiB cannot fit, the payload DEGRADES (same 10-param
+    shape, smaller params — the dedup-hit structure being certified is
+    payload-size independent) down to a 10 MiB floor instead of
+    skipping; ``"reduced": true`` marks the result."""
     n_params, param_bytes = 10, 10 << 20
+    if budget_s is not None and est_gbps:
+        # Two takes + fingerprint/commit overheads must fit the section
+        # budget; allot the takes ~25% of it at the estimated link rate.
+        movable = est_gbps * 1024**3 * budget_s * 0.25
+        param_bytes = int(
+            min(10 << 20, max(1 << 20, movable / n_params))
+        )
+    reduced = param_bytes < 10 << 20
     model = SyntheticModel(
         n_params=n_params, param_bytes=param_bytes, seed=23
     )
@@ -502,6 +549,7 @@ def _run_incremental_block(bench_dir: str) -> dict:
         "full_take_s": round(full_s, 3),
         "incremental_take_s": round(inc_s, 3),
         "speedup": round(full_s / max(inc_s, 1e-9), 2),
+        "reduced": reduced,
     }
 
 
@@ -602,6 +650,8 @@ def _bench_body(bench_dir: str) -> None:
         )
         _RESULTS["sharded_cpu"] = {"ok": False, "skipped": "budget"}
         _RESULTS["scaling"] = {"ok": False, "skipped": "budget"}
+        _note_gap("sharded_cpu", "budget below the sub-bench floor")
+        _note_gap("scaling", "budget below the sub-bench floor")
 
     _phase("d2h probe")
     d2h_gbps = _probe_d2h_gbps()
@@ -1196,15 +1246,35 @@ def _bench_body(bench_dir: str) -> None:
         # moment, so their RATIO is robust to the link's minute-scale
         # swings even when the absolute times are not.
         _phase("incremental take")
-        inc_est_s = 0.1 / max(min(d2h_gbps, h2d_gbps), 1e-6)
-        if _remaining_s() < max(150.0, 2.2 * inc_est_s + 90.0):
+        inc_link_gbps = max(min(d2h_gbps, h2d_gbps), 1e-6)
+        inc_est_s = 0.1 / inc_link_gbps
+        # Reserve headroom for the stall section + the summary emit; the
+        # section DEGRADES its payload inside what remains rather than
+        # skipping outright (BENCH_r05), and only a budget that cannot
+        # carry even the 10 MiB floor records a gap.
+        inc_budget_s = _remaining_s() - 120.0
+        if _remaining_s() >= max(150.0, 2.2 * inc_est_s + 90.0):
+            inc_budget_s = None  # full budget: no reduction needed
+        if inc_budget_s is not None and (
+            inc_budget_s < 30.0
+            or inc_link_gbps * 1024**3 * inc_budget_s * 0.25 < 10 << 20
+        ):
             _RESULTS["incremental"] = {
                 "ok": False,
+                "skipped": "deadline",
                 "error": "skipped: hard deadline",
             }
+            _note_gap(
+                "incremental",
+                "remaining budget below the 10 MiB reduced floor",
+            )
         else:
             try:
-                _RESULTS["incremental"] = _run_incremental_block(bench_dir)
+                _RESULTS["incremental"] = _run_incremental_block(
+                    bench_dir,
+                    budget_s=inc_budget_s,
+                    est_gbps=inc_link_gbps if inc_budget_s else None,
+                )
             except Exception as e:
                 _RESULTS["incremental"] = {"ok": False, "error": repr(e)}
         print(
@@ -1219,14 +1289,23 @@ def _bench_body(bench_dir: str) -> None:
         # is measured against an idle device. Runs after the restore so
         # nothing else contends for the chip.
         _phase("in-situ stall")
-        if _remaining_s() < 180:
+        if _remaining_s() < 90:
             _RESULTS["step_stall"] = {
                 "ok": False,
+                "skipped": "deadline",
                 "error": "skipped: hard deadline",
             }
+            _note_gap(
+                "step_stall",
+                "remaining budget below the reduced-loop floor",
+            )
         else:
+            # A tight budget runs the REDUCED loop (24 steps, small
+            # model) rather than skipping: a lower-confidence stall
+            # number beats a silent gap (BENCH_r05).
             _RESULTS["step_stall"] = _run_stall_bench(
-                timeout_s=min(420.0, _remaining_s() - 60.0)
+                timeout_s=min(420.0, _remaining_s() - 60.0),
+                reduced=_remaining_s() < 240,
             )
         print(f"[bench] step stall: {_RESULTS['step_stall']}", file=sys.stderr)
 

@@ -7,3 +7,10 @@ def write_marker(path, payload):
     with open(tmp, "wb") as f:
         f.write(payload)
     os.replace(tmp, path)
+
+
+def append_record(path, line):
+    # Append-only log: the write IS the publish, but nothing fsyncs it
+    # before the function signals success.
+    with open(path, "a") as f:
+        f.write(line + "\n")

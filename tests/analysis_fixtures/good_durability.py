@@ -21,3 +21,17 @@ def write_marker_bare_fsync(path, payload):
         f.flush()
         fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def append_record_durable(path, line):
+    # Append-only log with the append fsync'd before success.
+    with open(path, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def append_record_ephemeral(path, line):
+    with open(path, "a") as f:
+        # snapcheck: disable=durability-order -- ephemeral log fixture
+        f.write(line + "\n")

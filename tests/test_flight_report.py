@@ -134,7 +134,7 @@ def test_delete_removes_reports(tmp_path):
     snap = Snapshot.take(str(tmp_path / "snap"), {"model": model})
     snap.restore({"model": _Model({"w": np.zeros(64, np.float32)})})
     assert (tmp_path / "snap" / ".report.json").exists()
-    assert (tmp_path / "snap" / ".report.restore.rank0.json").exists()
+    assert (tmp_path / "snap" / ".report.restore.json").exists()
     snap.delete()
     leftovers = (
         list((tmp_path / "snap").rglob("*"))
@@ -164,12 +164,18 @@ def test_restore_report_breakdown():
         )
 
     run_thread_ranks(2, restore_fn)
+    # Restore symmetry: ONE merged rank-0 digest with per-rank
+    # breakdowns (same gather routes as take reports), not N loose
+    # rank-local files.
+    doc = json.loads(store["snap/.report.restore.json"])
+    assert doc["kind"] == "restore"
+    assert doc["world_size"] == 2
+    assert len(doc["ranks"]) == 2
+    assert not any(
+        k.startswith("snap/.report.restore.rank") for k in store
+    )
     for rank in (0, 1):
-        doc = json.loads(store[f"snap/.report.restore.rank{rank}.json"])
-        assert doc["kind"] == "restore"
-        # rank-local ranks list, but the REAL restoring world is recorded
-        assert doc["world_size"] == 2
-        (summary,) = doc["ranks"]
+        summary = doc["ranks"][rank]
         assert summary["rank"] == rank
         # the read/consume/assemble breakdown is present and the bytes
         # match what this rank's manifest view implies
@@ -180,6 +186,9 @@ def test_restore_report_breakdown():
         }
         assert summary["bytes"] == summary["scheduler_ops"]["read"]["bytes"]
         assert summary["scheduler_ops"]["consume"]["count"] > 0
+    assert doc["totals"]["bytes"] == sum(
+        s["bytes"] for s in doc["ranks"]
+    )
 
 
 # ------------------------------------------------------------ inspect bridge

@@ -33,7 +33,7 @@ def _count_payload_files(root: str) -> int:
         for f in files:
             rel = os.path.relpath(os.path.join(dirpath, f), root)
             if rel == ".snapshot_metadata" or rel.startswith(
-                (".completed", ".report", "refs")
+                (".completed", ".report", ".telemetry", "refs")
             ):
                 continue
             n += 1

@@ -94,11 +94,17 @@ def test_blocking_sync_negative():
 
 def test_durability_order_positive():
     result = analyze("bad_durability.py", [DurabilityOrderRule()])
-    assert findings(result) == [("SNAP002", 9)]  # os.replace, no fsync
+    assert findings(result) == [
+        ("SNAP002", 9),  # os.replace, no fsync
+        ("SNAP002", 16),  # append-mode write, no fsync (ledger arm)
+    ]
     assert "fsync" in result.violations[0].message
+    assert "append" in result.violations[1].message
 
 
 def test_durability_order_negative():
+    # Fsynced renames, fsynced appends, and a justified ephemeral-append
+    # suppression are all clean.
     result = analyze("good_durability.py", [DurabilityOrderRule()])
     assert findings(result) == []
 
@@ -451,7 +457,7 @@ def test_cli_baseline_roundtrip(tmp_path):
     assert wrote.returncode == 0
     gated = run_cli("--baseline", baseline, bad)
     assert gated.returncode == 0
-    assert "1 baselined" in gated.stdout
+    assert "2 baselined" in gated.stdout
 
 
 def test_cli_rule_filter_and_usage_errors():

@@ -136,6 +136,14 @@ def compare(
                 f"note: NEW run has {flag}=true — its numbers are "
                 f"not certified; treat this comparison accordingly"
             )
+    for side, doc in (("OLD", old), ("NEW", new)):
+        gaps = doc.get("gaps")
+        if isinstance(gaps, list) and gaps:
+            lines.append(
+                f"note: {side} run never measured section(s) "
+                f"{', '.join(map(str, gaps))} (deadline gaps — missing "
+                f"data, not zero; see bench.py)"
+            )
     verdicts = (
         (old.get("phase_verdict") or {}).get("dominant_phase"),
         (new.get("phase_verdict") or {}).get("dominant_phase"),
@@ -179,6 +187,11 @@ def _self_test() -> int:
     )
     joined = "\n".join(lines)
     assert "restore_uncertified" in joined and "read -> consume" in joined
+    lines, reg = compare(
+        base, dict(base, gaps=["step_stall", "incremental"]), 0.2
+    )
+    assert not reg, "gaps are missing data, never a regression"
+    assert any("step_stall" in line for line in lines), lines
     print("bench_compare self-test OK")
     return 0
 

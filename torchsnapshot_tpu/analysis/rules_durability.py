@@ -16,6 +16,16 @@ file handle opened in that function and later calls
 and the rename, the rename is flagged. (A correct sequence is
 ``f.flush(); os.fsync(f.fileno())`` before the rename — flush pushes
 Python's userspace buffer, fsync pushes the kernel's.)
+
+Append-only logs get the same discipline (the telemetry-ledger append
+path motivated this arm): a write through a handle opened in append
+mode (``"a"``/``"ab"``) IS its own publish — the record becomes visible
+to every reader the moment it lands, and callers treat the function's
+return as success. If no ``os.fsync`` follows the last append-mode
+write in the function, a crash after "success" silently loses the
+record (the append must land before any success log/marker). Flagged on
+the write; genuinely ephemeral appends (best-effort telemetry export)
+carry a justified suppression instead.
 """
 
 import ast
@@ -24,10 +34,35 @@ from typing import List, Optional, Sequence
 from .core import Diagnostic, Rule, dotted_name
 
 
-def _opened_handles(fn: ast.AST) -> set:
-    """Names bound via ``with open(...) as f`` / ``os.fdopen(...) as f``
-    or ``f = open(...)`` within this function (not nested functions)."""
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string of an ``open()``-style call, if static."""
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and isinstance(
+        mode_node.value, str
+    ):
+        return mode_node.value
+    return None
+
+
+def _opened_handles(fn: ast.AST) -> tuple:
+    """``(handles, append_handles)``: names bound via ``with open(...)
+    as f`` / ``os.fdopen(...) as f`` or ``f = open(...)`` within this
+    function (not nested functions); ``append_handles`` is the subset
+    whose literal mode contains ``"a"`` (append-only logs)."""
     handles = set()
+    append_handles = set()
+
+    def note(name: str, call: ast.AST) -> None:
+        handles.add(name)
+        mode = _open_mode(call) if isinstance(call, ast.Call) else None
+        if mode is not None and "a" in mode:
+            append_handles.add(name)
+
     for node in _walk_function(fn):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
@@ -36,13 +71,13 @@ def _opened_handles(fn: ast.AST) -> set:
                     and isinstance(item.optional_vars, ast.Name)
                     and _is_open_call(item.context_expr)
                 ):
-                    handles.add(item.optional_vars.id)
+                    note(item.optional_vars.id, item.context_expr)
         elif isinstance(node, ast.Assign):
             if _is_open_call(node.value):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
-                        handles.add(t.id)
-    return handles
+                        note(t.id, node.value)
+    return handles, append_handles
 
 
 def _is_open_call(node: ast.AST) -> bool:
@@ -96,10 +131,11 @@ class DurabilityOrderRule(Rule):
     def _check_function(
         self, fn: ast.AST, path: str, fsync_names: set
     ) -> List[Diagnostic]:
-        handles = _opened_handles(fn)
+        handles, append_handles = _opened_handles(fn)
         if not handles:
             return []
         write_lines: List[int] = []
+        append_writes: List[ast.Call] = []
         fsync_lines: List[int] = []
         renames: List[ast.Call] = []
         for node in _walk_function(fn):
@@ -113,15 +149,37 @@ class DurabilityOrderRule(Rule):
                 and node.func.value.id in handles
             ):
                 write_lines.append(node.lineno)
+                if node.func.value.id in append_handles:
+                    append_writes.append(node)
             elif name is not None and (
                 name.endswith(".fsync") or name in fsync_names
             ):
                 fsync_lines.append(node.lineno)
             elif name in ("os.replace", "os.rename"):
                 renames.append(node)
+        diags: List[Diagnostic] = []
+        if append_writes:
+            # Append arm: the write IS the publish for an append-only
+            # log; the last append must be fsync'd before the function
+            # can signal success.
+            last_append = max(w.lineno for w in append_writes)
+            if not any(f >= last_append for f in fsync_lines):
+                node = max(append_writes, key=lambda w: w.lineno)
+                diags.append(
+                    self.diag(
+                        path,
+                        node,
+                        "append-mode write is never os.fsync'd before "
+                        "the function returns: the appended record is "
+                        "the publish itself, and a crash after callers "
+                        "observed success can silently lose it (fsync "
+                        "the handle after the last append, or suppress "
+                        "with a justification if the log is genuinely "
+                        "ephemeral).",
+                    )
+                )
         if not renames or not write_lines:
-            return []
-        diags = []
+            return diags
         for rename in renames:
             prior_writes = [w for w in write_lines if w < rename.lineno]
             if not prior_writes:

@@ -97,7 +97,16 @@ def assert_reclaimed(base_url: str, live_steps: Sequence[int]) -> None:
     """Assert storage under ``base_url`` holds ONLY the live steps'
     objects: their payload prefixes and step markers — no tombstones, no
     stray markers, no payloads of pruned or crashed takes. The leak
-    check run after recovery re-drove every interrupted operation."""
+    check run after recovery re-drove every interrupted operation.
+
+    The telemetry ledger (``.telemetry/``, telemetry/ledger.py) is
+    durable metadata by contract — its records describe the run, not
+    any one step, and survive prune/reconcile by design — so it is
+    never a leak (torn ``*.tmp<pid>`` debris under it still is)."""
+    from ..telemetry.ledger import LEDGER_DIR
+
+    import re
+
     live = set(live_steps)
     allowed_markers = {f"{_STEP_PREFIX}{s}" for s in live}
     allowed_prefixes = tuple(f"step-{s}/" for s in live)
@@ -106,10 +115,18 @@ def assert_reclaimed(base_url: str, live_steps: Sequence[int]) -> None:
         objs = asyncio.run(storage.list_prefix("")) or []
     finally:
         storage.close()
+
+    def _is_ledger(o: str) -> bool:
+        return o.startswith(f"{LEDGER_DIR}/") and not re.search(
+            r"\.tmp\d+$", o
+        )
+
     leaked = [
         o
         for o in objs
-        if o not in allowed_markers and not o.startswith(allowed_prefixes)
+        if o not in allowed_markers
+        and not o.startswith(allowed_prefixes)
+        and not _is_ledger(o)
     ]
     assert not leaked, (
         f"leaked objects after recovery (live steps {sorted(live)}): "

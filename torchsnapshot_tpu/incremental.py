@@ -63,6 +63,22 @@ class IncrementalStats:
     dedup_hits: int = 0
     dedup_bytes: int = 0
     written: int = 0
+    # Manifest-churn accounting (telemetry/ledger.py): bytes of array
+    # leaves THIS RANK owned in the base manifest whose logical paths do
+    # not exist in the new take — state that was dropped between
+    # consecutive takes. 0 without a base.
+    removed_bytes: int = 0
+
+    def churn_note(self, has_base: bool) -> dict:
+        """The per-rank churn block the flight recorder attaches to its
+        summary; the ledger sums these across ranks at commit."""
+        return {
+            "unchanged_bytes": self.dedup_bytes,
+            "removed_bytes": self.removed_bytes,
+            "dedup_hits": self.dedup_hits,
+            "fingerprinted": self.fingerprinted,
+            "basis": "incremental" if has_base else "full",
+        }
 
 
 @dataclass
@@ -207,6 +223,15 @@ def _entry_nbytes(entry: ArrayEntry) -> int:
         return array_nbytes(entry.dtype, entry.shape)
     # Size ESTIMATE for retention accounting; an exotic dtype degrades
     # to 0 (counted as "cheap to keep"), never blocks a snapshot.
+    except Exception:  # snapcheck: disable=swallowed-exception -- size estimate
+        return 0
+
+
+def _region_nbytes(dtype: str, sizes: Any) -> int:
+    from .serialization import array_nbytes
+
+    try:
+        return array_nbytes(dtype, list(sizes))
     except Exception:  # snapcheck: disable=swallowed-exception -- size estimate
         return 0
 
@@ -361,6 +386,32 @@ def apply_incremental(
                 dropped.add(id(chunk))
                 stats.dedup_hits += 1
                 stats.dedup_bytes += _entry_nbytes(chunk)
+
+    # Churn: array state this rank owned in the base but dropped from
+    # the new take (a deleted optimizer slot, a removed parameter).
+    # Ownership diff only ("<rank>/<logical>" keys), so per-rank values
+    # count exactly once across ranks. Replicated leaves are mirrored
+    # under EVERY rank's prefix in the merged base manifest, so a
+    # removed one would be counted world_size times when the ledger
+    # sums the per-rank notes — rank 0 counts those alone.
+    own_prefix = f"{rank}/"
+    for full_path, base_entry in ctx.metadata.manifest.items():
+        if not full_path.startswith(own_prefix):
+            continue
+        logical = full_path[len(own_prefix):]
+        if logical in manifest:
+            continue
+        if getattr(base_entry, "replicated", False) and rank != 0:
+            continue
+        if isinstance(base_entry, ShardedArrayEntry):
+            # This rank's shards only; the full logical shape repeats
+            # under every owning rank's prefix and must not multiply.
+            for shard in base_entry.shards:
+                stats.removed_bytes += _region_nbytes(
+                    shard.array.dtype, shard.sizes
+                )
+        elif isinstance(base_entry, ArrayEntry):
+            stats.removed_bytes += _entry_nbytes(base_entry)
 
     if dropped:
         write_reqs[:] = [

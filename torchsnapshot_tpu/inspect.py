@@ -182,6 +182,15 @@ def main(argv=None) -> int:
         "0 healthy, 1 findings, 2 no report to diagnose",
     )
     parser.add_argument(
+        "--timeline",
+        action="store_true",
+        help="render the telemetry ledger's per-step trends (take "
+        "seconds, GB/s, stall %%, retries, churn, goodput) for PATH "
+        "(a CheckpointManager base or snapshot root) and run the "
+        "regression sentinel; exit 0 healthy, 1 regression, 2 no "
+        "ledger (see telemetry/timeline.py)",
+    )
+    parser.add_argument(
         "--diff",
         metavar="OLDER",
         help="content-diff PATH against the OLDER snapshot: which "
@@ -203,13 +212,19 @@ def main(argv=None) -> int:
         bool(args.diff),
         bool(args.report),
         bool(args.doctor),
+        bool(args.timeline),
     ]
     if sum(exclusive) > 1:
         parser.error(
             "--verify, --delete/--sweep, --convert-back, --steps, "
-            "--reconcile, --copy-to, --diff, --report, and --doctor "
-            "are mutually exclusive; run them in separate invocations"
+            "--reconcile, --copy-to, --diff, --report, --doctor, and "
+            "--timeline are mutually exclusive; run them in separate "
+            "invocations"
         )
+    if args.timeline:
+        from .telemetry import timeline as _timeline
+
+        return _timeline.main([args.path])
     if args.report:
         return _print_reports(args.path)
     if args.doctor:

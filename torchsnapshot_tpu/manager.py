@@ -373,14 +373,23 @@ class CheckpointManager:
         — a crash between the fs plugin's tmp-write and rename sub-steps
         leaves one, and no marker/tombstone path ever resolves it (it
         merely triggers a malformed-marker warning on every listing).
-        Age-guarded like every sweep."""
+        The telemetry ledger's ``.telemetry/`` prefix gets the same
+        treatment for ``*.tmp<pid>`` append debris — but NEVER the
+        ledger object itself: reconcile treats committed ledger records
+        as durable metadata (telemetry/ledger.py). Age-guarded like
+        every sweep."""
         import re
+
+        from .telemetry.ledger import LEDGER_DIR
 
         doomed = []
         for prefix in (_STEP_PREFIX, _PRUNING_PREFIX):
             for obj in asyncio.run(storage.list_prefix(prefix)) or []:
                 if re.fullmatch(r"\d+\.tmp\d+", obj[len(prefix):]):
                     doomed.append(obj)
+        for obj in asyncio.run(storage.list_prefix(LEDGER_DIR + "/")) or []:
+            if re.search(r"\.tmp\d+$", obj):
+                doomed.append(obj)
         self._sweep_aged_objects(storage, doomed, "torn control file")
 
     def _clean_progress_debris(self, storage: Any, objs) -> None:

@@ -51,6 +51,8 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from . import telemetry, tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
 from .telemetry import export as telemetry_export
+from .telemetry import goodput as goodput_acct
+from .telemetry import ledger as runledger
 from .telemetry import metrics as _metric_names
 from .telemetry import progress as liveprog
 from .telemetry import report as flight
@@ -166,7 +168,11 @@ class Snapshot:
         _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
         try:
-            with tracing.span("Snapshot.take", path=path):
+            # The whole sync take blocks the caller's training loop:
+            # attribute it to checkpoint time (telemetry/goodput.py).
+            with goodput_acct.blocked("sync_take"), tracing.span(
+                "Snapshot.take", path=path
+            ):
                 merged = cls._take_impl(
                     path=path,
                     app_state=app_state,
@@ -235,19 +241,23 @@ class Snapshot:
         storage = url_to_storage_plugin(path)
         background = _BackgroundTake()
         try:
-            cls._take_impl(
-                path=path,
-                app_state=app_state,
-                coordinator=coordinator,
-                storage=storage,
-                replicated=replicated or [],
-                background=background,
-                compression=compression,
-                stage=stage,
-                base_path=base_path,
-                fingerprint=fingerprint,
-                base_metadata=_reusable_base_metadata(base, base_path),
-            )
+            # Only the foreground (the consistent-cut capture before
+            # this returns) stalls training; the drain is free unless
+            # the caller blocks in wait() (accounted there).
+            with goodput_acct.blocked("async_stall"):
+                cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    coordinator=coordinator,
+                    storage=storage,
+                    replicated=replicated or [],
+                    background=background,
+                    compression=compression,
+                    stage=stage,
+                    base_path=base_path,
+                    fingerprint=fingerprint,
+                    base_metadata=_reusable_base_metadata(base, base_path),
+                )
         except BaseException:
             storage.close()
             raise
@@ -370,7 +380,7 @@ class Snapshot:
             with recorder.phase("incremental"), tracing.span(
                 "Snapshot.incremental", path=path
             ):
-                base_paths_meta, _ = apply_incremental(
+                base_paths_meta, inc_stats = apply_incremental(
                     manifest,
                     pending_write_reqs,
                     rank=rank,
@@ -380,6 +390,12 @@ class Snapshot:
                     base_metadata=base_metadata,
                     coordinator=coordinator if base_path is not None else None,
                 )
+            # Manifest-churn note for the flight summary: the ledger
+            # aggregates these per-rank blocks into the take digest's
+            # added/unchanged/removed bytes + incremental efficiency.
+            recorder.note(
+                churn=inc_stats.churn_note(base_path is not None)
+            )
             if background is None and base_path is not None:
                 # Sync takes suppressed prepare-time eager D2H copies so
                 # dedup hits never pay the transfer; start them now for
@@ -388,6 +404,13 @@ class Snapshot:
                     stager = wr.buffer_stager
                     if isinstance(stager, ArrayBufferStager):
                         stager.kickoff_host_copy()
+        else:
+            # Full take without a fingerprint pass: everything written
+            # is "added"; basis=full tells timeline the efficiency is
+            # structural, not a measured dedup miss.
+            from .incremental import IncrementalStats
+
+            recorder.note(churn=IncrementalStats().churn_note(False))
 
         budget = get_process_memory_budget_bytes(coordinator)
         merged_metadata: Optional[SnapshotMetadata] = None
@@ -485,16 +508,18 @@ class Snapshot:
                     recorder.rank_summary()
                 )
                 if rank == 0:
-                    _write_report_best_effort(
-                        storage,
-                        flight.build_report(
-                            "take",
-                            path,
-                            take_id,
-                            coordinator.get_world_size(),
-                            summaries,
-                        ),
+                    report = flight.build_report(
+                        "take",
+                        path,
+                        take_id,
+                        coordinator.get_world_size(),
+                        summaries,
                     )
+                    _write_report_best_effort(storage, report)
+                    # The committed take's digest lands in the durable
+                    # cross-take ledger (telemetry/ledger.py) — rank 0
+                    # only, after the metadata commit, best-effort.
+                    _ledger_append_best_effort(path, report)
                 # The all-gather gave EVERY rank the merged view; the
                 # caller seeds its handle's cache with it.
                 merged_metadata = metadata
@@ -631,7 +656,9 @@ class Snapshot:
         rank = coordinator.get_rank()
         storage = self._open_storage()
         try:
-            with tracing.span("Snapshot.restore", path=self.path):
+            with goodput_acct.blocked("restore"), tracing.span(
+                "Snapshot.restore", path=self.path
+            ):
                 return self._restore_impl(
                     app_state, coordinator, rank, storage, paths,
                     verify_device=verify_device,
@@ -709,7 +736,7 @@ class Snapshot:
             )
         watch.finish()
         self._finish_restore_report(
-            recorder, read_stats, storage, rank, coordinator.get_world_size()
+            recorder, read_stats, storage, rank, coordinator
         )
         if verify_device:
             verified, skipped = _verify_restored_fingerprints(verify_jobs)
@@ -736,12 +763,17 @@ class Snapshot:
         read_stats: Dict[str, Any],
         storage: StoragePlugin,
         rank: int,
-        world_size: int,
+        coordinator: Coordinator,
     ) -> None:
-        """Fold the read pipeline's stats into the flight recorder and
-        write the rank-local restore report beside the manifest.
-        Best-effort throughout: a read-only snapshot location (or any
-        storage failure) must never fail the restore it describes."""
+        """Fold the read pipeline's stats into the flight recorder,
+        gather every rank's summary over the coordinator (the restore
+        path is foreground and already collective — the same transport
+        the KV commit route uses for take summaries), and have rank 0
+        write ONE merged ``.report.restore.json`` digest with per-rank
+        breakdowns plus the ledger's restore record. The gather is
+        unconditional (every rank must issue the identical collective
+        sequence); the writes are best-effort: a read-only snapshot
+        location must never fail the restore it describes."""
         assemble_s = read_stats.pop("assemble_s", 0.0)
         recorder.note_pipeline(read_stats)
         ops = read_stats.get("ops") or {}
@@ -752,27 +784,39 @@ class Snapshot:
             "consume", (ops.get("consume") or {}).get("seconds", 0.0)
         )
         recorder.add_phase("assemble", assemble_s)
+        # Observability may never fail the restore it describes: the
+        # state is fully restored by now, so even the gather collective
+        # failing (KV hiccup/timeout) is caught — every rank catches
+        # locally and it is the last collective of the restore, so a
+        # partial failure cannot desynchronize later operations.
         try:
-            # ranks holds only THIS rank's summary (the report is
-            # rank-local by design — restore runs no extra collectives),
-            # but world_size records the real restoring world so the
-            # rendering doesn't claim a single-rank job.
-            report = flight.build_report(
-                "restore",
-                self.path,
-                None,
-                world_size,
-                [recorder.rank_summary()],
+            summaries = coordinator.all_gather_object(
+                recorder.rank_summary()
             )
-            asyncio.run(
-                flight.awrite_json(
-                    storage, flight.restore_report_fname(rank), report
+            if rank == 0:
+                report = flight.build_report(
+                    "restore",
+                    self.path,
+                    None,
+                    coordinator.get_world_size(),
+                    summaries,
                 )
-            )
+                try:
+                    asyncio.run(
+                        flight.awrite_json(
+                            storage, flight.RESTORE_REPORT_FNAME, report
+                        )
+                    )
+                except Exception as e:
+                    # debug, not warning: restoring from a read-only
+                    # location is legitimate and would otherwise warn on
+                    # every restore.
+                    logger.debug(
+                        "restore flight-record write failed: %r", e
+                    )
+                _ledger_append_best_effort(self.path, report)
         except Exception as e:
-            # debug, not warning: restoring from a read-only location is
-            # legitimate and would otherwise warn on every restore.
-            logger.debug("restore flight-record write failed: %r", e)
+            logger.warning("restore report gather failed: %r", e)
         flight.local_export(recorder)
 
     def delete(self, sweep: bool = False, force: bool = False) -> None:
@@ -813,6 +857,13 @@ class Snapshot:
         part uploads that a sweep must not destroy mid-flight. Backends
         that cannot report object age sweep unconditionally (set the env
         var to 0 to force that everywhere, e.g. in tests).
+
+        Telemetry-ledger note: a BARE snapshot's ``.telemetry/`` prefix
+        is its own and is deleted with it (no orphaned stubs). A
+        CheckpointManager run's ledger lives at the manager BASE —
+        outside every ``step-<N>`` prefix — so per-step deletes and
+        retention prunes structurally cannot touch the run's
+        longitudinal history (telemetry/ledger.py).
         """
         # Parse config BEFORE any destructive work: a malformed value
         # must surface as a config error, not abort a half-done delete.
@@ -892,6 +943,16 @@ class Snapshot:
             )
             if own_progress:
                 markers = markers + list(own_progress)
+            # A BARE snapshot's telemetry ledger lives in its own prefix
+            # and goes with it — deleting the snapshot must not orphan
+            # a .telemetry/ stub. (CheckpointManager runs ledger at the
+            # BASE, never under step-<N>, so step deletes/prunes can
+            # never touch the longitudinal record; see ledger.py.)
+            own_ledger = asyncio.run(
+                storage.list_prefix(runledger.LEDGER_DIR + "/")
+            )
+            if own_ledger:
+                markers = markers + list(own_ledger)
 
             async def _delete_all() -> None:
                 # Uncommit first; then payload deletes are order-
@@ -1580,6 +1641,16 @@ class PendingSnapshot:
         """
         if self._result is not None:
             return self._result
+        return self._wait_blocked(timeout_s)
+
+    def _wait_blocked(self, timeout_s: float) -> Snapshot:
+        # The caller is blocked on the background drain: goodput
+        # attributes this wait to checkpoint time (a drain that always
+        # finishes before the next wait() costs ~nothing here).
+        with goodput_acct.blocked("drain_wait"):
+            return self._wait_impl(timeout_s)
+
+    def _wait_impl(self, timeout_s: float) -> Snapshot:
         deadline = time.monotonic() + timeout_s
         thread = self._background.thread
         if thread is not None:
@@ -2820,16 +2891,20 @@ async def _acommit_via_storage(
                         storage, flight.rank_report_path(take_id, r)
                     )
                 )
+            report = flight.build_report(
+                kind, snapshot_path, take_id, world_size, summaries
+            )
             try:
                 await flight.awrite_json(
-                    storage,
-                    flight.REPORT_FNAME,
-                    flight.build_report(
-                        kind, snapshot_path, take_id, world_size, summaries
-                    ),
+                    storage, flight.REPORT_FNAME, report
                 )
             except Exception as e:
                 logger.warning("flight-record report write failed: %r", e)
+            # Ledger digest for the committed take: this route is the
+            # async drain (and large-manifest sync commits), so the
+            # append runs inside the existing event loop. Best-effort,
+            # after the metadata commit, rank 0 only.
+            await _aledger_append_best_effort(snapshot_path, report)
             for r in range(1, world_size):
                 try:
                     await _delete_ignore_missing(
@@ -2866,6 +2941,36 @@ async def _awrite_snapshot_metadata(
 
 def _write_snapshot_metadata(storage: StoragePlugin, metadata: SnapshotMetadata) -> None:
     asyncio.run(_awrite_snapshot_metadata(storage, metadata))
+
+
+def _ledger_append_best_effort(
+    snapshot_path: str, report: Dict[str, Any]
+) -> None:
+    """Fold the merged flight report into a ledger digest and append it
+    (rank 0, post-commit). Best-effort like every telemetry write — a
+    failed append warns and counts, never fails the commit it records;
+    a SimulatedCrash (BaseException) still rips through."""
+    try:
+        runledger.append_for_snapshot(
+            snapshot_path, runledger.digest_from_report(report)
+        )
+    except Exception as e:
+        telemetry.counter(_metric_names.LEDGER_APPEND_FAILURES).inc()
+        logger.warning("telemetry ledger append failed: %r", e)
+
+
+async def _aledger_append_best_effort(
+    snapshot_path: str, report: Dict[str, Any]
+) -> None:
+    """Async-context variant of :func:`_ledger_append_best_effort` for
+    the storage commit route (which already runs in an event loop)."""
+    try:
+        await runledger.aappend_for_snapshot(
+            snapshot_path, runledger.digest_from_report(report)
+        )
+    except Exception as e:
+        telemetry.counter(_metric_names.LEDGER_APPEND_FAILURES).inc()
+        logger.warning("telemetry ledger append failed: %r", e)
 
 
 def _write_report_best_effort(storage: StoragePlugin, report: Dict[str, Any]) -> None:

@@ -28,6 +28,17 @@ Three layers, smallest first:
   skew-corrected clock, with the cross-rank critical path.
 - **Doctor** (:mod:`.doctor`) — structured anomaly findings (rule id +
   evidence + remediation) from flight reports; ``inspect --doctor``.
+- **Ledger / snapledger** (:mod:`.ledger`) — durable cross-take record:
+  every committed take/restore appends a checksummed digest to
+  ``<root>/.telemetry/ledger.jsonl`` (rank-0-only, crash-tolerant,
+  torn-tail-skipping parser; survives delete/prune/reconcile).
+- **Goodput** (:mod:`.goodput`) — train-vs-checkpoint wall-time
+  attribution: call ``goodput.step()`` once per train step; the
+  library reports its own blocking automatically.
+- **Timeline** (:mod:`.timeline`) —
+  ``python -m torchsnapshot_tpu.telemetry.timeline <base>`` renders
+  per-step trends from the ledger (or a dir of BENCH_*.json) and runs
+  a median/MAD regression sentinel; exit 0/1/2 for CI.
 
 NOTE: :mod:`.report` is deliberately NOT imported here — it depends on
 ``io_types``, which itself records metrics through this package; keeping
@@ -39,6 +50,7 @@ import time
 from typing import Any, Dict, Optional
 
 from . import metrics as _m
+from . import goodput  # noqa: F401  (telemetry.goodput.step() is the train-loop hook)
 from .metrics import (
     REGISTRY,
     Counter,
@@ -56,6 +68,7 @@ __all__ = [
     "MetricsRegistry",
     "counter",
     "gauge",
+    "goodput",
     "histogram",
     "snapshot",
     "reset",

@@ -28,6 +28,8 @@ budget-stall-dominated    budget stall >= 25% of a rank's wall time
 retry-storm               storage retries >= 10 across the operation
 straggler-rank            a rank's wall >= 1.5x the rank median (>2s)
 imbalanced-stripe         max rank bytes >= 2x the rank median
+checkpoint-overhead-      goodput attribution shows checkpointing over
+above-budget              TPUSNAPSHOT_CKPT_BUDGET_PCT (default 5%)
 missing-rank-summary      a rank's summary never arrived (null)
 ========================  =============================================
 
@@ -44,6 +46,8 @@ import sys
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.env import env_float
+
 # Ratio thresholds, shared with summarize's dominance verdict where the
 # same question is asked of a trace instead of a report.
 _DOMINANCE_RATIO = 3.0
@@ -52,6 +56,12 @@ _RETRY_STORM_COUNT = 10
 _STRAGGLER_RATIO = 1.5
 _STRAGGLER_MIN_WALL_S = 2.0
 _STRIPE_RATIO = 2.0
+# Checkpoint-overhead budget: the goodput accountant's attribution must
+# cover at least this much wall time before the budget verdict means
+# anything (two steps of a toy loop prove nothing).
+_CKPT_BUDGET_ENV_VAR = "TPUSNAPSHOT_CKPT_BUDGET_PCT"
+_DEFAULT_CKPT_BUDGET_PCT = 5.0
+_MIN_GOODPUT_WINDOW_S = 10.0
 # Phases must clear this floor before a ratio means anything: a 0.05s
 # consume "dominating" a 0.006s read is scheduler jitter on a tiny
 # operation, not a pathology worth a remediation hint — the findings
@@ -326,6 +336,54 @@ def _rule_imbalanced_stripe(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_checkpoint_overhead(report: Dict[str, Any]) -> Optional[Finding]:
+    """Goodput verdict: checkpointing ate more than its wall-time budget
+    (``TPUSNAPSHOT_CKPT_BUDGET_PCT``, default 5%). Needs a rank summary
+    carrying the goodput accountant's attribution — i.e. a train loop
+    that calls ``telemetry.goodput.step()``."""
+    if report.get("kind") not in ("take", "async_take"):
+        return None
+    budget_pct = env_float(_CKPT_BUDGET_ENV_VAR, _DEFAULT_CKPT_BUDGET_PCT)
+    worst: Optional[Dict[str, Any]] = None
+    for s in _ranks(report):
+        gp = s.get("goodput") or {}
+        pct = gp.get("checkpoint_overhead_pct")
+        window_s = (gp.get("train_s") or 0.0) + (gp.get("checkpoint_s") or 0.0)
+        if pct is None or window_s < _MIN_GOODPUT_WINDOW_S:
+            continue
+        if pct > budget_pct and (worst is None or pct > worst["overhead_pct"]):
+            worst = {
+                "rank": s.get("rank"),
+                "overhead_pct": pct,
+                "budget_pct": budget_pct,
+                "train_s": gp.get("train_s"),
+                "checkpoint_s": gp.get("checkpoint_s"),
+                "by_mode": gp.get("by_mode"),
+            }
+    if worst is None:
+        return None
+    return Finding(
+        rule="checkpoint-overhead-above-budget",
+        severity=(
+            "critical" if worst["overhead_pct"] >= 2 * budget_pct else "warn"
+        ),
+        title=(
+            f"checkpointing consumed {worst['overhead_pct']:.1f}% of wall "
+            f"time against a {budget_pct:g}% budget"
+        ),
+        evidence=worst,
+        remediation=(
+            "checkpoint overhead exceeds the budget "
+            f"({_CKPT_BUDGET_ENV_VAR}). by_mode names the spender: "
+            "sync_take -> switch to async_save; async_stall -> stage="
+            '"device" or shrink the cut; drain_wait -> the drain is '
+            "slower than the save interval (raise the interval, use "
+            "incremental takes, or check the storage backend); also see "
+            "timeline's goodput trend for when the overhead started."
+        ),
+    )
+
+
 def _rule_missing_summary(report: Dict[str, Any]) -> Optional[Finding]:
     ranks = report.get("ranks") or []
     missing = [i for i, s in enumerate(ranks) if not s]
@@ -355,6 +413,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_retry_storm,
     _rule_straggler,
     _rule_imbalanced_stripe,
+    _rule_checkpoint_overhead,
     _rule_missing_summary,
 ]
 

@@ -265,6 +265,12 @@ def append_jsonl(path: str, record: Dict[str, Any]) -> None:
     line = json.dumps(record, sort_keys=True, default=str)
     with _export_lock:
         with open(path, "a") as f:
+            # No fsync: the JSONL stream is ephemeral observability by
+            # contract (best-effort export; a crash loses at most the
+            # last line of a convenience file). The DURABLE append-only
+            # record is the telemetry ledger, whose appends go through
+            # the storage plugin's fsync'd atomic replace (ledger.py).
+            # snapcheck: disable=durability-order -- ephemeral telemetry export
             f.write(line + "\n")
 
 

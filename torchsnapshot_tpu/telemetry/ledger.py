@@ -1,0 +1,511 @@
+"""Durable cross-take telemetry ledger.
+
+snapstats answers "what happened inside THIS take" (one ``.report.json``
+per snapshot); snapwatch answers "what is happening right now". Neither
+answers the longitudinal questions that decide whether checkpointing is
+paying for itself: *is checkpoint overhead creeping up across this
+run? did throughput regress after step 40k? how incremental are
+consecutive takes really?* The ledger is the durable record those
+questions fold over: every committed take and every completed restore
+appends one compact, schema-versioned digest to
+
+    <ledger-root>/.telemetry/ledger.jsonl
+
+where the ledger root is the CheckpointManager base for step-indexed
+snapshots (``<base>/step-<N>`` appends to ``<base>/.telemetry/``, so
+consecutive steps share one ledger) and the snapshot prefix itself for
+bare takes.
+
+Durability contract (the ledger is *metadata*, not ephemeral export):
+
+- **rank-0-only append** — the digests are built from the merged flight
+  report at commit time, which only rank 0 holds; no cross-rank writes.
+- **crash-tolerant** — appends go through the storage plugin's atomic
+  whole-object replace (fs: tmp + fsync + rename), so a crash mid-append
+  can never corrupt previously committed records; at worst the new
+  record is absent.
+- **per-record checksum + torn-tail-skipping parser** — each line is
+  ``{"crc": <crc32 of the canonical record json>, "record": {...}}``.
+  A torn write (a non-atomic backend, or faultline's torn-write
+  injection) truncates the tail; the parser verifies every line and
+  skips unparseable/mismatched ones, and the next append rewrites from
+  the last *valid* prefix — the torn tail is dropped, prior records are
+  preserved byte-for-byte.
+- **never orphaned** — the manager-base ledger sits OUTSIDE every
+  ``step-<N>`` prefix, so per-step deletes and retention prunes
+  structurally cannot reach it: records outlive the pruned steps they
+  describe, which is the whole point of a longitudinal record.
+  ``reconcile()`` treats it as durable metadata (its debris sweeps
+  clear only torn ``*.tmp<pid>`` leftovers under ``.telemetry/``,
+  age-guarded, never the ledger object). A BARE snapshot's ledger
+  lives in its own prefix and is removed by ``Snapshot.delete`` along
+  with everything else — no orphaned ``.telemetry/`` stubs.
+
+Like every telemetry write, appends are best-effort at the call sites:
+a ledger failure warns and never fails the commit it describes — but
+within ``append`` the storage write lands BEFORE any success signal
+(log line / ``ledger_appended`` trace instant), the same
+durability-before-publish ordering snapcheck's SNAP002 enforces.
+
+Record schema (``format_version`` 1); nullable fields are null when the
+source operation did not produce them::
+
+    {
+      "format_version": 1,
+      "kind": "take" | "async_take" | "restore",
+      "ts_epoch_s": <wall-clock epoch at append>,
+      "path": "<snapshot url>",
+      "step": <int | null>,              # parsed from .../step-<N>
+      "take_id": "<nonce | null>",
+      "world_size": N,
+      "wall_s": ...,                     # slowest rank's wall
+      "bytes": ...,                      # payload bytes moved
+      "gbps": ...,
+      "stall_s": ...,                    # summed budget stall
+      "stall_pct": ...,                  # stall / (world * wall)
+      "retries": ..., "faults": ...,
+      "phases": {"<phase>_s": max-across-ranks, ...},
+      "goodput": {...} | null,           # goodput.snapshot() at commit
+      "churn": {"added_bytes", "unchanged_bytes", "removed_bytes",
+                "efficiency", "basis": "incremental" | "full"} | null,
+      "doctor": ["<rule id>", ...]       # rules that fired on the report
+    }
+"""
+
+import asyncio
+import json
+import logging
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..io_types import IOReq, io_payload, is_not_found_error
+from . import metrics as _m
+from .metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+LEDGER_FORMAT_VERSION = 1
+LEDGER_DIR = ".telemetry"
+LEDGER_OBJECT = ".telemetry/ledger.jsonl"
+# Appends are read-validate-rewrite of the whole active object (the
+# storage plugins expose atomic whole-object replace, which is also
+# what keeps faultline's crash/torn injection meaningful here). To keep
+# cumulative append IO linear rather than quadratic over a long run,
+# the active object rotates into an immutable archive segment
+# (.telemetry/ledger-archive-<n>.jsonl) once it crosses this cap;
+# read_records folds archives + active back into one history.
+LEDGER_ROTATE_ENV_VAR = "TPUSNAPSHOT_LEDGER_ROTATE_BYTES"
+_DEFAULT_LEDGER_ROTATE_BYTES = 4 << 20
+ARCHIVE_PREFIX = ".telemetry/ledger-archive-"
+
+_STEP_LEAF_RE = re.compile(r"^step-(\d+)$")
+_ARCHIVE_RE = re.compile(r"^\.telemetry/ledger-archive-(\d+)\.jsonl$")
+
+
+def ledger_root_for(snapshot_path: str) -> Tuple[str, Optional[int]]:
+    """``(ledger_root_url, step)`` for a snapshot path.
+
+    ``<base>/step-<N>`` ledgers at ``<base>`` with ``step=N`` so every
+    CheckpointManager save lands in ONE ledger; anything else ledgers
+    in its own prefix with ``step=None``."""
+    trimmed = snapshot_path.rstrip("/")
+    head, _, leaf = trimmed.rpartition("/")
+    m = _STEP_LEAF_RE.match(leaf)
+    if m and head and not head.endswith(":/"):
+        return head, int(m.group(1))
+    return trimmed, None
+
+
+# ------------------------------------------------------------- line codec
+
+
+def _canonical(record: Dict[str, Any]) -> str:
+    return json.dumps(record, sort_keys=True, separators=(",", ":"))
+
+
+def encode_line(record: Dict[str, Any]) -> str:
+    """One ledger line: the record wrapped with its crc32 checksum."""
+    payload = _canonical(record)
+    crc = f"{zlib.crc32(payload.encode('utf-8')) & 0xFFFFFFFF:08x}"
+    return json.dumps(
+        {"crc": crc, "record": record},
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def decode_line(line: str) -> Optional[Dict[str, Any]]:
+    """The record, or None for a torn/corrupt line."""
+    try:
+        doc = json.loads(line)
+        record = doc["record"]
+        crc = f"{zlib.crc32(_canonical(record).encode('utf-8')) & 0xFFFFFFFF:08x}"
+        if crc != doc["crc"]:
+            return None
+        return record
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def parse_ledger_bytes(
+    raw: bytes,
+) -> Tuple[List[Dict[str, Any]], int, int]:
+    """``(records, valid_prefix_len, n_skipped)``.
+
+    ``valid_prefix_len`` is the byte offset covering the leading run of
+    valid, newline-terminated lines — the next append rewrites from
+    exactly there, dropping any torn tail. Lines after the first bad
+    one are still *parsed* (a mid-file tear on an exotic backend must
+    not hide later records from readers) but are not part of the valid
+    prefix."""
+    records: List[Dict[str, Any]] = []
+    skipped = 0
+    valid_prefix_len = 0
+    prefix_intact = True
+    pos = 0
+    n = len(raw)
+    while pos < n:
+        nl = raw.find(b"\n", pos)
+        if nl < 0:
+            # Unterminated final piece: a torn append's tail by
+            # construction (every complete append is newline-terminated).
+            piece, end, terminated = raw[pos:], n, False
+        else:
+            piece, end, terminated = raw[pos:nl], nl + 1, True
+        if piece.strip():
+            record = (
+                decode_line(piece.decode("utf-8", errors="replace"))
+                if terminated
+                else None
+            )
+            if record is not None:
+                records.append(record)
+                if prefix_intact:
+                    valid_prefix_len = end
+            else:
+                skipped += 1
+                prefix_intact = False
+        elif prefix_intact and terminated:
+            valid_prefix_len = end  # blank line: harmless, keep it
+        pos = end
+    return records, valid_prefix_len, skipped
+
+
+# ------------------------------------------------------------ storage IO
+
+
+async def _aread_raw(storage: Any) -> bytes:
+    try:
+        io_req = IOReq(path=LEDGER_OBJECT)
+        await storage.read(io_req)
+        return bytes(io_payload(io_req))
+    except Exception as e:
+        if not is_not_found_error(e):
+            logger.warning("ledger read failed (treating as empty): %r", e)
+        return b""
+
+
+# Serializes the read-validate-rewrite across THREADS in this process:
+# an async drain committing a take races the foreground (a restore, a
+# sync take, another drain) to the same ledger object, and without
+# mutual exclusion the second replace would silently erase the first
+# record. Held across the awaits deliberately — each appender runs its
+# own event loop, appends are short, and cross-thread blocking is the
+# point. (Cross-PROCESS appenders don't exist by construction: rank 0
+# of one run is the only writer; two unrelated jobs sharing a ledger
+# root would be misconfiguration.)
+_APPEND_LOCK = threading.Lock()
+
+
+async def aappend(storage: Any, record: Dict[str, Any]) -> None:
+    """Append ``record`` to the ledger behind ``storage`` (a plugin
+    rooted at the ledger root). Read-validate-rewrite under the
+    process-wide append lock: the current object's valid prefix plus
+    the new line is written back through the plugin's atomic replace.
+    The write lands before the success instant — durability before
+    publish."""
+    from .. import tracing
+
+    with _APPEND_LOCK:
+        await _aappend_locked(storage, record, tracing)
+
+
+async def _aappend_locked(
+    storage: Any, record: Dict[str, Any], tracing: Any
+) -> None:
+    from ..utils.env import env_int
+
+    raw = await _aread_raw(storage)
+    prior, valid_len, skipped = parse_ledger_bytes(raw)
+    if skipped:
+        logger.warning(
+            "ledger at %s: dropping %d torn/corrupt line(s) past byte %d",
+            LEDGER_OBJECT,
+            skipped,
+            valid_len,
+        )
+    record = _with_goodput_window(record, prior)
+    prefix = raw[:valid_len]
+    rotate_bytes = env_int(
+        LEDGER_ROTATE_ENV_VAR, _DEFAULT_LEDGER_ROTATE_BYTES
+    )
+    if rotate_bytes > 0 and len(prefix) >= rotate_bytes:
+        # Archive-then-truncate, in that order: a crash between the two
+        # writes duplicates history (archive + still-full active, and
+        # readers dedup nothing — duplicates are benign trend points)
+        # rather than losing it.
+        seq = await _next_archive_seq(storage)
+        archive = IOReq(
+            path=f"{ARCHIVE_PREFIX}{seq:06d}.jsonl", data=prefix
+        )
+        await storage.write(archive)
+        prefix = b""
+    line = encode_line(record) + "\n"
+    io_req = IOReq(path=LEDGER_OBJECT, data=prefix + line.encode("utf-8"))
+    await storage.write(io_req)
+    REGISTRY.counter(
+        _m.LEDGER_RECORDS_TOTAL, kind=str(record.get("kind", "?"))
+    ).inc()
+    tracing.instant(
+        "ledger_appended",
+        kind=str(record.get("kind", "?")),
+        step=record.get("step") if record.get("step") is not None else -1,
+    )
+
+
+def _with_goodput_window(
+    record: Dict[str, Any], prior: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Stamp the goodput delta since the previous goodput-bearing
+    record: ``window_fraction`` / ``window_overhead_pct``. The
+    accountant's totals are lifetime-cumulative, and a cumulative
+    fraction flattens as the run grows — overhead creeping up after
+    step 40k would hide inside it, which is exactly the question the
+    ledger exists to answer. First record (or right after a process
+    restart, when cumulative counters moved backwards, or after a
+    segment rotation) falls back to the cumulative fraction."""
+    gp = record.get("goodput")
+    if not isinstance(gp, dict):
+        return record
+    train = gp.get("train_s")
+    ckpt = gp.get("checkpoint_s")
+    if not isinstance(train, (int, float)) or not isinstance(
+        ckpt, (int, float)
+    ):
+        return record
+    prev = next(
+        (
+            r.get("goodput")
+            for r in reversed(prior)
+            if isinstance(r.get("goodput"), dict)
+        ),
+        None,
+    )
+    window_fraction = gp.get("goodput_fraction")
+    window_overhead = gp.get("checkpoint_overhead_pct")
+    if prev is not None:
+        d_train = train - (prev.get("train_s") or 0.0)
+        d_ckpt = ckpt - (prev.get("checkpoint_s") or 0.0)
+        if d_train >= 0 and d_ckpt >= 0 and d_train + d_ckpt > 0:
+            window_fraction = round(d_train / (d_train + d_ckpt), 6)
+            window_overhead = round(
+                100.0 * d_ckpt / (d_train + d_ckpt), 3
+            )
+    gp = dict(
+        gp,
+        window_fraction=window_fraction,
+        window_overhead_pct=window_overhead,
+    )
+    return dict(record, goodput=gp)
+
+
+async def _next_archive_seq(storage: Any) -> int:
+    seqs = [0]
+    for p in await storage.list_prefix(ARCHIVE_PREFIX) or []:
+        m = _ARCHIVE_RE.match(p)
+        if m:
+            seqs.append(int(m.group(1)) + 1)
+    return max(seqs)
+
+
+def append_for_snapshot(snapshot_path: str, record: Dict[str, Any]) -> None:
+    """Resolve the ledger root for ``snapshot_path``, stamp the step
+    (unless the caller already set one), and append synchronously.
+    Raises on failure — call sites wrap with their own best-effort
+    handling (and the append-failures counter)."""
+    from ..storage_plugin import url_to_storage_plugin
+
+    root, step = ledger_root_for(snapshot_path)
+    if record.get("step") is None:
+        record = dict(record, step=step)
+    storage = url_to_storage_plugin(root)
+    try:
+        asyncio.run(aappend(storage, record))
+    finally:
+        storage.close()
+
+
+async def aappend_for_snapshot(
+    snapshot_path: str, record: Dict[str, Any]
+) -> None:
+    """Async-context variant of :func:`append_for_snapshot` (the async
+    drain's commit path already runs inside an event loop)."""
+    from ..storage_plugin import url_to_storage_plugin
+
+    root, step = ledger_root_for(snapshot_path)
+    if record.get("step") is None:
+        record = dict(record, step=step)
+    storage = url_to_storage_plugin(root)
+    try:
+        await aappend(storage, record)
+    finally:
+        storage.close()
+
+
+def read_records(
+    path: str,
+) -> Tuple[List[Dict[str, Any]], int]:
+    """``(records, n_skipped)`` from a ledger root URL (folds rotated
+    ``ledger-archive-*.jsonl`` segments plus the active
+    ``<path>/.telemetry/ledger.jsonl``), a direct ``.jsonl`` file path,
+    or a snapshot path (resolved through :func:`ledger_root_for`).
+    Exact-duplicate records are dropped: a crash between the rotation's
+    archive write and the active truncate duplicates history rather
+    than losing it, and readers fold that back out."""
+    import os
+
+    from ..storage_plugin import url_to_storage_plugin
+
+    if "://" not in path and os.path.isfile(path):
+        with open(path, "rb") as f:
+            raw = f.read()
+        records, _, skipped = parse_ledger_bytes(raw)
+        return _dedup(records), skipped
+    root, _ = ledger_root_for(path)
+    storage = url_to_storage_plugin(root)
+    try:
+
+        async def _read_all() -> Tuple[List[bytes], bytes]:
+            archives = sorted(
+                p
+                for p in await storage.list_prefix(ARCHIVE_PREFIX) or []
+                if _ARCHIVE_RE.match(p)
+            )
+            chunks = []
+            for p in archives:
+                io_req = IOReq(path=p)
+                await storage.read(io_req)
+                chunks.append(bytes(io_payload(io_req)))
+            return chunks, await _aread_raw(storage)
+
+        chunks, active = asyncio.run(_read_all())
+    finally:
+        storage.close()
+    records: List[Dict[str, Any]] = []
+    skipped = 0
+    for raw in chunks + [active]:
+        part, _, part_skipped = parse_ledger_bytes(raw)
+        records.extend(part)
+        skipped += part_skipped
+    return _dedup(records), skipped
+
+
+def _dedup(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for r in records:
+        key = _canonical(r)
+        if key not in seen:
+            seen.add(key)
+            out.append(r)
+    return out
+
+
+# --------------------------------------------------------- digest builders
+
+
+def _phase_max(
+    summaries: List[Optional[Dict[str, Any]]],
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in summaries:
+        for name, v in ((s or {}).get("phases") or {}).items():
+            out[name] = max(out.get(name, 0.0), float(v))
+    return {k: round(v, 6) for k, v in sorted(out.items())}
+
+
+def _churn_totals(
+    summaries: List[Optional[Dict[str, Any]]], added_bytes: int
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank churn notes (see incremental.py) into the
+    digest's churn block. None when no rank recorded churn (a take with
+    neither base nor fingerprints)."""
+    noted = [s.get("churn") for s in summaries if s and s.get("churn")]
+    if not noted:
+        return None
+    unchanged = sum(int(c.get("unchanged_bytes", 0)) for c in noted)
+    removed = sum(int(c.get("removed_bytes", 0)) for c in noted)
+    basis = (
+        "incremental"
+        if any(c.get("basis") == "incremental" for c in noted)
+        else "full"
+    )
+    denom = added_bytes + unchanged
+    return {
+        "added_bytes": int(added_bytes),
+        "unchanged_bytes": unchanged,
+        "removed_bytes": removed,
+        "efficiency": round(unchanged / denom, 6) if denom > 0 else None,
+        "basis": basis,
+    }
+
+
+def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold a merged flight report (take or restore) into one ledger
+    record. Runs the doctor over the report so the record carries the
+    rule ids that fired — timeline folds this history across takes."""
+    from .doctor import diagnose_report
+
+    totals = report.get("totals") or {}
+    summaries = report.get("ranks") or []
+    wall_s = float(totals.get("wall_s") or 0.0)
+    nbytes = int(totals.get("bytes") or 0)
+    world = int(report.get("world_size") or 1)
+    stall_s = float(totals.get("stall_s") or 0.0)
+    goodput = next(
+        (s.get("goodput") for s in summaries if s and s.get("goodput")),
+        None,
+    )
+    try:
+        doctor_rules = [f.rule for f in diagnose_report(report)]
+    except Exception:  # snapcheck: disable=swallowed-exception -- telemetry digest must not fail the commit
+        doctor_rules = []
+    return {
+        "format_version": LEDGER_FORMAT_VERSION,
+        "kind": report.get("kind", "?"),
+        "ts_epoch_s": round(time.time(), 3),
+        "path": report.get("path", ""),
+        "step": None,  # stamped by append_for_snapshot
+        "take_id": report.get("take_id"),
+        "world_size": world,
+        "wall_s": round(wall_s, 6),
+        "bytes": nbytes,
+        "gbps": (
+            round(nbytes / (1 << 30) / wall_s, 6) if wall_s > 0 else None
+        ),
+        "stall_s": round(stall_s, 6),
+        "stall_pct": (
+            round(100.0 * stall_s / (world * wall_s), 3)
+            if wall_s > 0
+            else None
+        ),
+        "retries": totals.get("retries", 0),
+        "faults": totals.get("faults", 0),
+        "phases": _phase_max(summaries),
+        "goodput": goodput,
+        "churn": _churn_totals(summaries, nbytes),
+        "doctor": doctor_rules,
+    }

@@ -324,3 +324,14 @@ MANAGER_PRUNE_SECONDS = "tpusnapshot_manager_prune_seconds"  # hist
 MANAGER_STEPS_PRUNED = "tpusnapshot_manager_steps_pruned_total"  # counter
 TAKES_TOTAL = "tpusnapshot_takes_total"  # counter {mode}
 RESTORES_TOTAL = "tpusnapshot_restores_total"  # counter
+GOODPUT_TRAIN_SECONDS = (
+    "tpusnapshot_goodput_train_seconds_total"  # counter
+)
+GOODPUT_CHECKPOINT_SECONDS = (
+    "tpusnapshot_goodput_checkpoint_seconds_total"  # counter {mode}
+)
+GOODPUT_FRACTION = "tpusnapshot_goodput_fraction"  # gauge
+LEDGER_RECORDS_TOTAL = "tpusnapshot_ledger_records_total"  # counter {kind}
+LEDGER_APPEND_FAILURES = (
+    "tpusnapshot_ledger_append_failures_total"  # counter
+)

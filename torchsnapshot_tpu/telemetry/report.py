@@ -11,9 +11,12 @@ route, through per-rank ``.report/<take_id>/<rank>`` storage objects on
 the marker route (the async drain must not touch the coordinator) — and
 rank 0 writes the merged report beside the metadata document.
 
-``restore`` writes a rank-local ``.report.restore.rank<N>.json`` with
-the read/consume/assemble breakdown — the file that would have named
-BENCH_r05's 176s consume-dominated restore without a trace viewer.
+``restore`` gathers every rank's read/consume/assemble breakdown over
+the coordinator (the restore path is foreground-collective already) and
+rank 0 writes one merged ``.report.restore.json`` digest — the document
+that would have named BENCH_r05's 176s consume-dominated restore
+without a trace viewer. Pre-digest snapshots may instead hold legacy
+rank-local ``.report.restore.rank<N>.json`` files; readers accept both.
 
 Reports are observability, not protocol: every write/read here is
 best-effort and may never fail the snapshot operation it describes.
@@ -70,11 +73,19 @@ REPORT_FORMAT_VERSION = 1
 REPORT_FNAME = ".report.json"
 # Listing prefix that covers every flight-record object a snapshot can
 # hold: the merged .report.json, per-rank .report/<take_id>/<rank>
-# summaries, and .report.restore.rank<N>.json restore records.
+# summaries, and the .report.restore.json restore digest (plus legacy
+# per-rank .report.restore.rank<N>.json records from older versions).
 REPORT_PREFIX = ".report"
 # Per-rank summary objects on the storage commit route, collected (and
 # deleted) by rank 0 after the completion markers land.
 RANK_REPORT_PREFIX = ".report/"
+# Merged restore digest: restore summaries ride the coordinator (the
+# restore path is foreground and already collective) and rank 0 writes
+# ONE document with per-rank breakdowns — take/restore symmetry instead
+# of N loose rank-local files.
+RESTORE_REPORT_FNAME = ".report.restore.json"
+# Prefix matching both the merged digest and legacy rank-local records.
+RESTORE_REPORT_PREFIX = ".report.restore."
 
 
 def rank_report_path(take_id: str, rank: int) -> str:
@@ -82,6 +93,8 @@ def rank_report_path(take_id: str, rank: int) -> str:
 
 
 def restore_report_fname(rank: int) -> str:
+    """Legacy rank-local restore record name (still read by inspect/
+    doctor for snapshots written before the merged digest existed)."""
     return f".report.restore.rank{rank}.json"
 
 
@@ -189,6 +202,14 @@ class FlightRecorder:
             },
         }
         summary.update(pipeline.get("extra", {}))
+        # Goodput attribution at summary time (present only once the
+        # accountant saw a train loop or a checkpoint wait): the doctor's
+        # checkpoint-overhead-above-budget rule and the ledger's goodput
+        # trend both read it from here.
+        from . import goodput as _goodput
+
+        if _goodput.has_data():
+            summary["goodput"] = _goodput.snapshot()
         return summary
 
 
