@@ -241,6 +241,8 @@ def _summary_doc() -> dict:
         "doctor_findings": r.get("doctor_findings"),
         "step_stall": r.get("step_stall"),
         "incremental": r.get("incremental"),
+        "hot_tier": r.get("hot_tier"),
+        "every_step": r.get("every_step"),
         "scaling": r.get("scaling"),
         "sharded_cpu": r.get("sharded_cpu"),
         "gaps": r.get("gaps", []),
@@ -551,6 +553,238 @@ def _run_incremental_block(
         "speedup": round(full_s / max(inc_s, 1e-9), 2),
         "reduced": reduced,
     }
+
+
+def _modeled_remote(gbps: float):
+    """Context manager wrapping every resolved storage plugin with the
+    token-rate throttle (``_ThrottledStorage``), via the same
+    ``set_plugin_wrap_hook`` seam faultline/hottier use (hooks chain):
+    the local bench dir stands in for an object store at ``gbps`` of
+    read/write bandwidth. Used by the hot-tier sections so the hot-vs-
+    durable comparison reflects the production gap (peer RAM vs object
+    store) rather than the local page cache — the MODELED rate is
+    reported in the section JSON, never passed off as a tunnel number."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _ctx():
+        import torchsnapshot_tpu.storage_plugin as _sp_mod
+
+        holder = {}
+
+        def _hook(plugin, url):
+            prev = holder["prev"]
+            base = prev(plugin, url) if prev is not None else plugin
+            return _ThrottledStorage(base, gbps)
+
+        holder["prev"] = _sp_mod.set_plugin_wrap_hook(_hook)
+        try:
+            yield
+        finally:
+            _sp_mod.set_plugin_wrap_hook(holder["prev"])
+
+    return _ctx()
+
+
+def run_hot_tier_block(
+    payload_bytes: int = 64 << 20,
+    modeled_durable_gbps: float = 0.03,
+    n_params: int = 8,
+) -> dict:
+    """Hot-tier vs durable-tier restore on the SAME snapshot payload
+    (hottier/): take with the tier on (ack at RAM, background tier-down),
+    then time one restore served from peer RAM against one served from
+    the durable tier behind a modeled object-store bandwidth. The
+    certified quantity is the ratio ``hot_vs_durable`` (>= 5x is the
+    ROADMAP item-5 acceptance bar); ``ok`` only asserts the runs were
+    clean (bit-exact, zero hot-tier fallbacks), so a smoke invocation
+    with a tiny payload cannot fake the headline. The default modeled
+    rate (0.03 GB/s) is GENEROUS to the durable tier: BENCH_r05
+    measured the real end-to-end restore at ~0.002 GB/s, 15x slower —
+    the reported ratio understates the production gap."""
+    from torchsnapshot_tpu import hottier
+
+    import uuid as _uuid
+
+    # memory:// backend: the modeled throttle is the ONLY storage cost,
+    # so the ratio measures the tier, not local-disk fsync jitter (the
+    # bench dir's disk stalls up to seconds under concurrent writeback).
+    root = f"memory://bench-hot-{_uuid.uuid4().hex[:10]}/snap"
+    param_bytes = max(1 << 16, payload_bytes // n_params)
+    model = SyntheticModel(
+        n_params=n_params, param_bytes=param_bytes, seed=31
+    )
+    jax.block_until_ready(list(model.params.values()))
+    reference = {
+        k: jax.device_get(v) for k, v in model.params.items()
+    }
+
+    def _zero_model():
+        target = SyntheticModel(
+            n_params=n_params, param_bytes=param_bytes, seed=31
+        )
+        target.params = {
+            k: jnp.zeros_like(v) for k, v in target.params.items()
+        }
+        return target
+
+    def _timed_restore():
+        target = _zero_model()
+        begin = time.monotonic()
+        Snapshot(root).restore({"model": target})
+        jax.block_until_ready(list(target.params.values()))
+        elapsed = time.monotonic() - begin
+        # Bit-exactness over the WHOLE payload (outside the timed
+        # window): certifying on a sampled param would let corruption
+        # in the others pass as ok.
+        exact = all(
+            bool((jax.device_get(target.params[k]) == reference[k]).all())
+            for k in reference
+        )
+        return elapsed, exact
+
+    try:
+        with _modeled_remote(modeled_durable_gbps):
+            hottier.reset_hot_tier()
+            hottier.enable_hot_tier(rank=0, world=2, k=2, drain="background")
+            try:
+                Snapshot.take(root, {"model": model})
+                drained = hottier.wait_drained(timeout_s=600.0)
+                hot_s, hot_exact = _timed_restore()
+                stats = hottier.runtime().stats_snapshot()
+            finally:
+                hottier.disable_hot_tier(flush=False)
+                hottier.reset_hot_tier()
+            # Same snapshot, tier off: every read pays the modeled
+            # durable-tier bandwidth.
+            durable_s, durable_exact = _timed_restore()
+        ratio = durable_s / max(hot_s, 1e-9)
+        return {
+            "ok": bool(
+                drained
+                and hot_exact
+                and durable_exact
+                and stats["fallback_objects"] == 0
+            ),
+            "bytes": n_params * param_bytes,
+            "hot_restore_s": round(hot_s, 3),
+            "durable_restore_s": round(durable_s, 3),
+            "hot_vs_durable": round(ratio, 2),
+            "meets_5x": bool(ratio >= 5.0),
+            "modeled_durable_gbps": modeled_durable_gbps,
+            "hot_objects": stats["hot_objects"],
+            "fallback_objects": stats["fallback_objects"],
+        }
+    finally:
+        import torchsnapshot_tpu.storage_plugin as _sp_mod
+
+        bucket = root.split("://", 1)[1].split("/", 1)[0]
+        _sp_mod._MEMORY_STORES.pop(bucket, None)
+
+
+def run_every_step_block(
+    n_steps: int = 6,
+    payload_bytes: int = 8 << 20,
+    train_step_s: float = 2.5,
+    modeled_durable_gbps: float = 0.05,
+) -> dict:
+    """Every-step checkpointing (the ROADMAP item-5 workload): a train
+    loop that async-saves EVERY step, once against the durable tier
+    alone (modeled object-store bandwidth) and once with the hot tier
+    on, feeding the goodput accountant both times — so the flight
+    reports and the manager-base ledger carry the attribution and the
+    checkpoint-overhead-above-budget / timeline machinery can certify
+    it. ``within_budget`` is the certified verdict: hot-tier overhead
+    under ``TPUSNAPSHOT_CKPT_BUDGET_PCT`` (default 5%) at a take
+    frequency where the durable tier alone blows the budget."""
+    import contextlib
+
+    from torchsnapshot_tpu import CheckpointManager, hottier
+    from torchsnapshot_tpu.telemetry import goodput
+    from torchsnapshot_tpu.telemetry import ledger as runledger
+
+    budget_pct = float(os.environ.get("TPUSNAPSHOT_CKPT_BUDGET_PCT", 5.0))
+    # At every-step cadence with a 2-step retention window, the sweep
+    # age guard (default 1h) spares every just-pruned step's young
+    # report/progress debris, so prune tombstones accumulate and each
+    # step re-drives ALL of them through the modeled-slow storage —
+    # measuring tombstone re-driving, not tier overhead. Disable it for
+    # the section (both legs identically; restored after).
+    prev_age = os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S")
+    os.environ["TPUSNAPSHOT_SWEEP_MIN_AGE_S"] = "0"
+
+    def _loop(tag: str, hot: bool) -> dict:
+        import uuid as _uuid
+
+        # memory:// base for the same reason as the hot_tier section:
+        # the modeled throttle, not local-disk fsync jitter, must be
+        # the storage cost both legs pay.
+        base = f"memory://bench-es-{_uuid.uuid4().hex[:8]}/{tag}"
+        model = SyntheticModel(
+            n_params=4, param_bytes=max(1 << 16, payload_bytes // 4), seed=77
+        )
+        jax.block_until_ready(list(model.params.values()))
+        goodput.reset()
+        mgr = CheckpointManager(base, max_to_keep=2)
+        tier_ctx = (
+            hottier.hot_tier(rank=0, world=2, k=2, drain="background")
+            if hot
+            else contextlib.nullcontext()
+        )
+        begin = time.monotonic()
+        with _modeled_remote(modeled_durable_gbps):
+            with tier_ctx:
+                for step in range(n_steps):
+                    time.sleep(train_step_s)  # the "train step"
+                    goodput.step()
+                    mgr.async_save(step, {"model": model}).wait()
+                if hot:
+                    hottier.wait_drained(timeout_s=600.0)
+        wall = time.monotonic() - begin
+        gp = goodput.snapshot()
+        goodput.reset()
+        records, _ = runledger.read_records(base)
+        hottier.reset_hot_tier()
+        out = {
+            "wall_s": round(wall, 3),
+            "overhead_pct": gp.get("checkpoint_overhead_pct"),
+            "by_mode": gp.get("by_mode"),
+            "steps": gp.get("steps"),
+            "ledger_records": len(records),
+        }
+        import torchsnapshot_tpu.storage_plugin as _sp_mod
+
+        _sp_mod._MEMORY_STORES.pop(base.split("://", 1)[1].split("/", 1)[0], None)
+        return out
+
+    try:
+        durable = _loop("durable", hot=False)
+        hot = _loop("hot", hot=True)
+        hot_pct = hot.get("overhead_pct")
+        durable_pct = durable.get("overhead_pct")
+        return {
+            "ok": bool(
+                hot_pct is not None
+                and durable_pct is not None
+                and hot["ledger_records"] >= n_steps
+                and hot_pct <= durable_pct
+            ),
+            "n_steps": n_steps,
+            "bytes_per_step": payload_bytes,
+            "train_step_s": train_step_s,
+            "modeled_durable_gbps": modeled_durable_gbps,
+            "budget_pct": budget_pct,
+            "durable": durable,
+            "hot": hot,
+            "within_budget": bool(
+                hot_pct is not None and hot_pct <= budget_pct
+            ),
+        }
+    finally:
+        if prev_age is None:
+            os.environ.pop("TPUSNAPSHOT_SWEEP_MIN_AGE_S", None)
+        else:
+            os.environ["TPUSNAPSHOT_SWEEP_MIN_AGE_S"] = prev_age
 
 
 def _floor_bytes() -> int:
@@ -1280,6 +1514,46 @@ def _bench_body(bench_dir: str) -> None:
         print(
             f"[bench] incremental: {_RESULTS['incremental']}",
             file=sys.stderr,
+        )
+
+        # Hot-tier sections (hottier/): CPU + local-fs payloads behind a
+        # MODELED object-store bandwidth — tenancy-independent like
+        # sharded_cpu, so they run on a fixed small budget. hot_tier
+        # certifies the >= 5x hot-vs-durable restore ratio; every_step
+        # certifies checkpoint overhead stays under
+        # TPUSNAPSHOT_CKPT_BUDGET_PCT at every-step take frequency.
+        _phase("hot tier")
+        if _remaining_s() < 75:
+            _RESULTS["hot_tier"] = {
+                "ok": False,
+                "skipped": "deadline",
+                "error": "skipped: hard deadline",
+            }
+            _note_gap("hot_tier", "remaining budget below the section floor")
+        else:
+            try:
+                _RESULTS["hot_tier"] = run_hot_tier_block()
+            except Exception as e:
+                _RESULTS["hot_tier"] = {"ok": False, "error": repr(e)}
+        print(f"[bench] hot tier: {_RESULTS['hot_tier']}", file=sys.stderr)
+
+        _phase("every-step checkpointing")
+        if _remaining_s() < 90:
+            _RESULTS["every_step"] = {
+                "ok": False,
+                "skipped": "deadline",
+                "error": "skipped: hard deadline",
+            }
+            _note_gap(
+                "every_step", "remaining budget below the section floor"
+            )
+        else:
+            try:
+                _RESULTS["every_step"] = run_every_step_block()
+            except Exception as e:
+                _RESULTS["every_step"] = {"ok": False, "error": repr(e)}
+        print(
+            f"[bench] every_step: {_RESULTS['every_step']}", file=sys.stderr
         )
 
         # Sharded/subdivided write-path coverage (CPU mesh, subprocess):

@@ -1,0 +1,548 @@
+"""snaptier: preemption-tolerant hot tier — replication, tier-down,
+degraded restore, and the host-loss x crash-point fault matrix.
+
+Fast tier (``-m faultline``, runs in tier-1): ack-before-drain
+semantics, the k-1 host-loss bit-exact e2e acceptance, per-object
+durable fallback (dead / corrupt replicas) with the
+``hot-tier-degraded`` doctor rule and the ledger ``tier`` field,
+capacity/eviction invariants, reconcile's keep-committed-undrained
+proof, and a stride-sampled crash matrix over the tiered
+save→commit→tier-down pipeline. The full per-op crash enumeration and
+the host-loss x crash-point product are also marked ``slow``.
+"""
+
+import json
+import uuid
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict, hottier
+from torchsnapshot_tpu import faultline as fl
+from torchsnapshot_tpu.hottier import tier as ht_tier
+from torchsnapshot_tpu.io_types import IOReq
+from torchsnapshot_tpu.manager import _step_dir
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+from torchsnapshot_tpu.telemetry import ledger as runledger
+from torchsnapshot_tpu.telemetry.doctor import diagnose_report
+
+pytestmark = pytest.mark.faultline
+
+
+# ----------------------------------------------------------------- helpers
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tier():
+    """Every test starts and ends with an empty hot tier and no runtime
+    (a leaked enable would silently re-route every other test's IO)."""
+    hottier.disable_hot_tier(flush=False)
+    hottier.reset_hot_tier()
+    yield
+    hottier.disable_hot_tier(flush=False)
+    hottier.reset_hot_tier()
+
+
+def _state(v, n=1024):
+    return {"s": StateDict(w=jnp.full((n,), float(v)))}
+
+
+def _target(n=1024):
+    return {"s": StateDict(w=jnp.zeros((n,)))}
+
+
+def _assert_restored(target, v):
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), float(v))
+
+
+def _mem_base(tag):
+    return f"memory://hottier-{tag}-{uuid.uuid4().hex[:10]}/run"
+
+
+def _durable_objects(url):
+    storage = url_to_storage_plugin(url)
+    try:
+        import asyncio
+
+        return sorted(asyncio.run(storage.list_prefix("")) or [])
+    finally:
+        storage.close()
+
+
+def _payload_objects(url):
+    return [o for o in _durable_objects(url) if hottier.is_payload_path(o)]
+
+
+def _read_json(url, path):
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import io_payload
+
+    storage = url_to_storage_plugin(url)
+    try:
+        io_req = IOReq(path=path)
+        asyncio.run(storage.read(io_req))
+        return json.loads(bytes(io_payload(io_req)).decode("utf-8"))
+    finally:
+        storage.close()
+
+
+# ------------------------------------------------- ack / drain / watermark
+
+
+def test_ack_before_drain_and_tierdown_watermark(tmp_path):
+    """The take commits with payloads k-replicated in peer RAM only;
+    tier-down persists them in the background and records the
+    ``.tierdown`` watermark; after a full drain the snapshot restores
+    from the durable tier alone."""
+    root = str(tmp_path / "step-0")
+    with hottier.hot_tier(rank=0, world=4, k=2, drain="manual"):
+        snap = Snapshot.take(root, _state(7))
+        # Committed (metadata durable) but payloads are hot-tier-only.
+        objs = _durable_objects(root)
+        assert ".snapshot_metadata" in objs
+        assert not _payload_objects(root)
+        assert ".tierdown" not in objs
+        # Restorable RIGHT NOW, from peer RAM.
+        target = _target()
+        snap.restore({"s": target["s"]})
+        _assert_restored(target, 7)
+        # Tier-down: payloads land durable, watermark follows.
+        hottier.drain_now()
+        assert _payload_objects(root)
+        watermark = _read_json(root, ".tierdown")
+        assert watermark["format_version"] == 1
+        assert watermark["drained_objects"] >= 1
+    # Tier disabled (RAM gone): the durable tier alone must suffice.
+    hottier.reset_hot_tier()
+    target = _target()
+    Snapshot(root).restore({"s": target["s"]})
+    _assert_restored(target, 7)
+
+
+def test_verify_clean_while_hot_only(tmp_path):
+    """Snapshot.verify() sees through the tier: a committed-but-undrained
+    snapshot scrubs clean (bytes exist in >= 1 tier, which is the tiered
+    integrity contract)."""
+    root = str(tmp_path / "step-0")
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        snap = Snapshot.take(root, _state(3))
+        assert not _payload_objects(root)
+        assert snap.verify() == {}
+
+
+# ------------------------------------------------------ host-loss restores
+
+
+@pytest.mark.parametrize("lost_host", [0, 1])
+def test_k1_host_loss_restores_bit_exact(lost_host):
+    """E2E acceptance: with k=2 and payloads living ONLY in peer RAM
+    (nothing drained), losing any k-1=1 host still restores bit-exact
+    from the surviving replicas."""
+    base = _mem_base("k1loss")
+    root = f"{base}/step-0"
+    rng = np.random.default_rng(42)
+    payload = rng.standard_normal(4096).astype(np.float32)
+    with hottier.hot_tier(rank=0, world=4, k=2, drain="manual"):
+        snap = Snapshot.take(root, {"s": StateDict(w=jnp.asarray(payload))})
+        assert not _payload_objects(root)  # hot-tier-only on purpose
+        hottier.kill_host(lost_host)
+        target = {"s": StateDict(w=jnp.zeros((4096,), jnp.float32))}
+        snap.restore(target)
+        np.testing.assert_array_equal(
+            np.asarray(target["s"]["w"]), payload
+        )
+        stats = hottier.runtime().stats_snapshot()
+        assert stats["fallback_objects"] == 0  # never touched durable
+
+
+def test_all_replicas_lost_falls_back_and_fires_doctor():
+    """Losing ALL replica hosts after tier-down degrades to per-object
+    durable reads; the restore stays bit-exact, the flight report's
+    ``tier`` block names the dead peers, the ``hot-tier-degraded``
+    doctor rule fires critical (100% of bytes fell back), and the
+    ledger record carries the ``tier`` field."""
+    base = _mem_base("alllost")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        snap = Snapshot.take(root, _state(9))
+        hottier.drain_now()  # durable copy exists; replicas evictable
+        hottier.kill_host(0)
+        hottier.kill_host(1)
+        target = _target()
+        snap.restore({"s": target["s"]})
+        _assert_restored(target, 9)
+        report = _read_json(root, ".report.restore.json")
+        tier_blocks = [
+            s.get("tier") for s in report["ranks"] if s and s.get("tier")
+        ]
+        assert tier_blocks, report["ranks"]
+        assert tier_blocks[0]["fallback_objects"] >= 1
+        assert tier_blocks[0]["hot_objects"] == 0
+        assert sorted(tier_blocks[0]["degraded_peers"]) == [0, 1]
+        findings = {f.rule: f for f in diagnose_report(report)}
+        assert "hot-tier-degraded" in findings
+        finding = findings["hot-tier-degraded"]
+        assert finding.severity == "critical"
+        assert finding.evidence["degraded_peers"] == "peer hosts 0-1"
+        assert finding.evidence["reasons"].get("dead", 0) >= 1
+        # Ledger: the restore record carries the tier attribution.
+        records, _ = runledger.read_records(root)
+        restores = [r for r in records if r["kind"] == "restore"]
+        assert restores and restores[-1]["tier"]["fallback_objects"] >= 1
+        assert restores[-1]["tier"]["degraded_peers"] == [0, 1]
+
+
+def test_corrupt_replica_falls_back_per_object():
+    """A replica that fails its fingerprint check is dropped and the
+    read falls over — to the durable tier here (k=1), bit-exact."""
+    base = _mem_base("corrupt")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=1, k=1, drain="manual"):
+        snap = Snapshot.take(root, _state(5))
+        hottier.drain_now()
+        # Flip one byte of the single replica in host 0's RAM.
+        with ht_tier._TIER_LOCK:
+            store = ht_tier._HOSTS[0]
+            key = next(iter(store.objects))
+            obj = store.objects[key]
+            obj.data = obj.data[:-1] + bytes([obj.data[-1] ^ 0xFF])
+        target = _target()
+        snap.restore({"s": target["s"]})
+        _assert_restored(target, 5)
+        stats = hottier.runtime().stats_snapshot()
+        assert stats["reasons"].get("corrupt", 0) >= 1
+        assert stats["fallback_objects"] >= 1
+        # The corrupt replica was dropped — nothing can read it again.
+        assert ht_tier.total_buffered_bytes() < 4096
+
+
+def test_lose_host_schedule_is_deterministic():
+    """faultline's host-loss schedule kills a peer at a fixed op
+    boundary: the take completes, the host is dead afterwards, and the
+    injection log records the hostloss."""
+    base = _mem_base("sched")
+    root = f"{base}/step-0"
+    sched = fl.FaultSchedule().lose_host(
+        1, op="write", path=".snapshot_metadata"
+    )
+    with fl.inject(sched) as ctl:
+        with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+            snap = Snapshot.take(root, _state(4))
+            assert 1 not in hottier.live_hosts()
+            assert ctl.fault_counts().get("hostloss") == 1
+            # Host 0's replica still serves the restore.
+            target = _target()
+            snap.restore({"s": target["s"]})
+            _assert_restored(target, 4)
+
+
+# --------------------------------------------------- capacity and eviction
+
+
+def test_undrained_never_evicted_capacity_degrades_to_write_through():
+    """An undrained object is the only copy outside its replica set:
+    capacity pressure must refuse the put (degrading the write to a
+    synchronous durable write-through), never evict undrained bytes."""
+    base = _mem_base("cap")
+    root = f"{base}/step-0"
+    # Room for roughly one 4 KiB payload per host.
+    with hottier.hot_tier(
+        rank=0, world=1, k=1, capacity_bytes=6000, drain="manual"
+    ):
+        snap = Snapshot.take(
+            root,
+            {
+                "a": StateDict(w=jnp.full((1024,), 1.0)),
+                "b": StateDict(w=jnp.full((1024,), 2.0)),
+            },
+        )
+        stats = hottier.runtime().stats_snapshot()
+        # One payload went hot, the other was refused and wrote through.
+        assert stats["write_through"] >= 1
+        assert ht_tier.total_buffered_bytes() <= 6000
+        # Everything still restores (mixed hot + durable).
+        target = {
+            "a": StateDict(w=jnp.zeros((1024,))),
+            "b": StateDict(w=jnp.zeros((1024,))),
+        }
+        snap.restore(target)
+        got = {
+            float(np.asarray(target["a"]["w"])[0]),
+            float(np.asarray(target["b"]["w"])[0]),
+        }
+        assert got == {1.0, 2.0}
+        # After tier-down the buffered object is drained and EVICTABLE:
+        # the next put may displace it.
+        hottier.drain_now()
+        rt = hottier.runtime()
+        assert rt.hot_put(root, "0/extra/blob", b"x" * 4096) == 1
+        assert ht_tier.total_buffered_bytes() <= 6000
+
+
+def test_k_env_knob(monkeypatch):
+    monkeypatch.setenv(hottier.K_ENV_VAR, "3")
+    with hottier.hot_tier(rank=0, world=8, drain="manual") as rt:
+        assert rt.k == 3
+        assert rt.replica_hosts() == [0, 1, 2]
+    monkeypatch.setenv(hottier.K_ENV_VAR, "99")
+    with hottier.hot_tier(rank=5, world=4, drain="manual") as rt:
+        assert rt.k == 4  # clamped to world
+        assert rt.replica_hosts() == [5 % 4, 2, 3, 0]
+
+
+# ------------------------------------------------- delete / reconcile GC
+
+
+def test_delete_cancels_pending_drain_and_drops_buffers(tmp_path):
+    """Deleting a committed-but-undrained snapshot cancels its pending
+    tier-down (a background drain must not resurrect deleted objects)
+    and drops its replicas; the ``.tierdown`` watermark goes with a
+    drained snapshot."""
+    root_a = str(tmp_path / "step-0")
+    root_b = str(tmp_path / "step-1")
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        snap_a = Snapshot.take(root_a, _state(1))
+        assert hottier.buffered_roots()
+        snap_a.delete()
+        assert not hottier.buffered_roots()
+        hottier.drain_now()  # nothing to resurrect
+        assert not _payload_objects(root_a)
+        # Drained snapshot: delete removes payloads AND the watermark.
+        snap_b = Snapshot.take(root_b, _state(2))
+        hottier.drain_now()
+        assert ".tierdown" in _durable_objects(root_b)
+        snap_b.delete()
+        assert ".tierdown" not in _durable_objects(root_b)
+        assert not hottier.buffered_roots()
+
+
+def test_reconcile_keeps_committed_undrained_drops_aged_orphans(
+    monkeypatch,
+):
+    """The reconcile sweep must never reclaim replicas a committed-but-
+    not-yet-drained take still needs (they are the only copy of its
+    payload bytes), while an uncommitted crashed take's buffers — which
+    nothing can ever resolve — are reclaimed once aged."""
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    base = _mem_base("reconcile")
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        mgr = CheckpointManager(base)
+        mgr.save(0, _state(0))  # committed, NOT drained
+        committed_root = _step_dir(base, 0)
+        # Fake an uncommitted crashed take: hot buffers, no metadata.
+        rt = hottier.runtime()
+        orphan_root = _step_dir(base, 99)
+        rt.hot_put(orphan_root, "0/s/w", b"y" * 512)
+        rt.enqueue_drain(orphan_root, "0/s/w")
+        assert set(hottier.buffered_roots()) == {
+            committed_root,
+            orphan_root,
+        }
+        mgr.reconcile(adopt=True)
+        # Orphan reclaimed (age guard disabled), committed kept.
+        assert set(hottier.buffered_roots()) == {committed_root}
+        # ... and the committed step still restores from the hot tier.
+        target = _target()
+        assert mgr.restore({"s": target["s"]}, step=0) == 0
+        _assert_restored(target, 0)
+        # With the age guard ON, even an uncommitted orphan is spared
+        # (it may be an in-flight take).
+        rt.hot_put(orphan_root, "0/s/w", b"y" * 512)
+        monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "3600")
+        mgr.reconcile(adopt=True)
+        assert orphan_root in hottier.buffered_roots()
+
+
+def test_drain_exhaustion_strands_then_redrives(monkeypatch):
+    """A durable outage outlasting the drain attempts leaves the object
+    STRANDED: wait_drained() must report the flush dirty (the hot copy
+    is the only copy — claiming success would let a caller tear the
+    tier down over it), and the next drain_now() re-drives it to a
+    clean tier-down."""
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    base = _mem_base("strand")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        # nth=2: the 1st match is the take's logical write (which the
+        # tier absorbs into RAM); every durable drain write after it
+        # fails permanently.
+        sched = fl.FaultSchedule().permanent(op="write", path="0/s/w", nth=2)
+        with fl.inject(sched):
+            snap = Snapshot.take(root, _state(8))
+            hottier.drain_now()  # attempts exhaust; object stranded
+            assert not hottier.wait_drained(timeout_s=1.0)
+            assert not _payload_objects(root)
+            # The snapshot is still fully restorable from the hot tier.
+            target = _target()
+            snap.restore({"s": target["s"]})
+            _assert_restored(target, 8)
+        # Outage over (faults uninstalled): re-drive to a clean flush.
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=5.0)
+        assert _payload_objects(root)
+        assert ".tierdown" in _durable_objects(root)
+
+
+def test_tierdown_write_failure_is_redriven(monkeypatch):
+    """A failed ``.tierdown`` watermark write must leave a re-drive
+    trigger even though the root is fully drained (no object item will
+    ever call back into the watermark path)."""
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    base = _mem_base("tdfail")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        sched = fl.FaultSchedule().permanent(op="write", path=".tierdown")
+        with fl.inject(sched):
+            Snapshot.take(root, _state(2))
+            hottier.drain_now()
+            assert _payload_objects(root)  # objects drained fine
+            assert ".tierdown" not in _durable_objects(root)
+            assert not hottier.wait_drained(timeout_s=1.0)
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=5.0)
+        assert ".tierdown" in _durable_objects(root)
+
+
+# --------------------------------------------------- crash/fault matrices
+
+
+def _prepare_matrix(monkeypatch, drained_history=True):
+    monkeypatch.setenv("TPUSNAPSHOT_SWEEP_MIN_AGE_S", "0")
+    hottier.reset_hot_tier()
+    hottier.reset_pending()
+    base = _mem_base("crashmx")
+    mgr = CheckpointManager(base, max_to_keep=1)
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+    if drained_history:
+        hottier.drain_now()
+    return base
+
+
+def _faulted_matrix(base):
+    # One full tiered lifecycle: take step 2 (replicate + ack + commit +
+    # marker + prune), then tier-down (drain + watermark).
+    CheckpointManager(base, max_to_keep=1).save(2, _state(2))
+    hottier.drain_now()
+
+
+def _probe(base):
+    def probe(step):
+        target = _target()
+        got = CheckpointManager(base).restore(target, step=step)
+        assert got == step
+        _assert_restored(target, step)
+
+    return probe
+
+
+def _check_matrix(base, outcome):
+    # (a)/(b): every marker-visible step restores clean (hot tier or
+    # durable); reconcile adopts committed-unmarked work and reclaims
+    # crashed debris — including hot-tier buffers.
+    res = fl.check_recovery_invariant(base, _probe(base))
+    outcome.marked_steps = res.marked_steps
+    outcome.adopted_steps = res.adopted_steps
+    # Recovery re-drive: a fresh save→drain cycle succeeds, re-drives
+    # any interrupted tier-down, and leaves no leaked objects in EITHER
+    # tier.
+    mgr = CheckpointManager(base, max_to_keep=1, reconcile_on_init="adopt")
+    mgr.save(3, _state(3))
+    hottier.drain_now()
+    mgr.reconcile(adopt=True)
+    assert mgr.latest_step() == 3
+    _probe(base)(3)
+    fl.assert_reclaimed(base, [3])
+    # Zero leaked hot-tier buffers: only the live step may stay hot.
+    live_root = _step_dir(base, 3)
+    assert set(hottier.buffered_roots()) <= {live_root}
+    # The live step finished its tier-down: watermark present.
+    assert ".tierdown" in _durable_objects(live_root)
+
+
+def test_tiered_crash_matrix_fast_subset(monkeypatch):
+    """Stride-sampled crash points across take→ack→commit→tier-down
+    with the hot tier on (tier-1). Proves restore-or-detect plus
+    leak-free reconcile at every sampled boundary — including the
+    hottier.replicate / hottier.drain / hottier.tierdown boundaries the
+    tier adds to the op stream."""
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        base = _prepare_matrix(monkeypatch)
+        total = fl.count_storage_ops(lambda: _faulted_matrix(base))
+        assert total > 0
+        stride = max(1, total // 6)
+        points = sorted(set(range(1, total + 1, stride)) | {1, total})
+        report = fl.enumerate_crash_points(
+            prepare=lambda: _prepare_matrix(monkeypatch),
+            faulted=_faulted_matrix,
+            check=_check_matrix,
+            crash_points=points,
+            total_ops=total,
+        )
+        assert set(report.outcomes) == set(points)
+        assert any(o.crashed for o in report.outcomes.values())
+
+
+@pytest.mark.slow
+def test_tiered_crash_matrix_full(monkeypatch):
+    """Full per-op crash enumeration over the tiered pipeline."""
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        report = fl.enumerate_crash_points(
+            prepare=lambda: _prepare_matrix(monkeypatch),
+            faulted=_faulted_matrix,
+            check=_check_matrix,
+        )
+        assert report.total_ops > 0
+        assert any(o.crashed for o in report.outcomes.values())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lost_host", [0, 1])
+def test_host_loss_x_crash_point_enumeration(monkeypatch, lost_host):
+    """The product matrix: at every sampled crash point, ALSO lose one
+    peer host before recovery runs — any k-1 loss composed with any
+    crash must still satisfy restore-or-detect with zero leaks."""
+
+    def check(base, outcome):
+        hottier.kill_host(lost_host)
+        try:
+            _check_matrix(base, outcome)
+        finally:
+            hottier.revive_host(lost_host)
+
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        base = _prepare_matrix(monkeypatch)
+        total = fl.count_storage_ops(lambda: _faulted_matrix(base))
+        points = sorted(
+            set(range(1, total + 1, max(1, total // 12))) | {1, total}
+        )
+        report = fl.enumerate_crash_points(
+            prepare=lambda: _prepare_matrix(monkeypatch),
+            faulted=_faulted_matrix,
+            check=check,
+            crash_points=points,
+            total_ops=total,
+        )
+        assert any(o.crashed for o in report.outcomes.values())
+
+
+def test_mid_replication_host_loss_during_take(monkeypatch):
+    """Partial-tier-down schedule: a peer dies WHILE the take is
+    replicating (lose_host bound to a payload write boundary). The take
+    must still commit (surviving replicas + write-through degradation)
+    and restore bit-exact."""
+    base = _mem_base("midloss")
+    root = f"{base}/step-0"
+    sched = fl.FaultSchedule().lose_host(1, op="hottier.replicate", nth=2)
+    with fl.inject(sched):
+        with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+            snap = Snapshot.take(root, _state(6))
+            target = _target()
+            snap.restore({"s": target["s"]})
+            _assert_restored(target, 6)
+            hottier.drain_now()  # tier-down proceeds from survivors
+            assert _payload_objects(root)
+            assert ".tierdown" in _durable_objects(root)

@@ -6,7 +6,7 @@ plus the async-take handle ``PendingSnapshot`` and the ``Coordinator``
 shim for explicit multi-process control.
 """
 
-from . import telemetry
+from . import hottier, telemetry
 from .coord import (
     Coordinator,
     DictStore,
@@ -40,6 +40,7 @@ __all__ = [
     "Stateful",
     "StoreCoordinator",
     "get_coordinator",
+    "hottier",
     "telemetry",
     "__version__",
 ]

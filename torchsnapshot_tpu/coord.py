@@ -258,6 +258,29 @@ class JaxStore(Store):
         return base64.b64decode(val.encode("ascii"), validate=True)
 
 
+def format_rank_list(ranks: List[int], noun: str = "rank") -> str:
+    """``[17]`` → "rank 17"; ``[1,2,3,7]`` → "ranks 1-3, 7". Runs
+    compress to ranges so a pod-scale stall (thousands of absent ranks)
+    reads as a handful of spans, not a 10 KB comma list. ``noun``
+    re-labels the members (the hot tier names "peer host 3" /
+    "peer hosts 0-2" with the same compression). Input must be sorted
+    ascending; empty input reads as "no <noun>s"."""
+    if not ranks:
+        return f"no {noun}s"
+    if len(ranks) == 1:
+        return f"{noun} {ranks[0]}"
+    spans = []
+    start = prev = ranks[0]
+    for r in ranks[1:]:
+        if r == prev + 1:
+            prev = r
+            continue
+        spans.append(f"{start}-{prev}" if prev > start else str(start))
+        start = prev = r
+    spans.append(f"{start}-{prev}" if prev > start else str(start))
+    return f"{noun}s " + ", ".join(spans)
+
+
 class Coordinator(abc.ABC):
     """Collective interface used by Snapshot (reference PGWrapper)."""
 
@@ -424,21 +447,7 @@ class StoreCoordinator(Coordinator):
 
     @staticmethod
     def _fmt_ranks(ranks: List[int]) -> str:
-        """``[17]`` → "rank 17"; ``[1,2,3,7]`` → "ranks 1-3, 7". Runs
-        compress to ranges so a pod-scale stall (thousands of absent
-        ranks) reads as a handful of spans, not a 10 KB comma list."""
-        if len(ranks) == 1:
-            return f"rank {ranks[0]}"
-        spans = []
-        start = prev = ranks[0]
-        for r in ranks[1:]:
-            if r == prev + 1:
-                prev = r
-                continue
-            spans.append(f"{start}-{prev}" if prev > start else str(start))
-            start = prev = r
-        spans.append(f"{start}-{prev}" if prev > start else str(start))
-        return "ranks " + ", ".join(spans)
+        return format_rank_list(ranks)
 
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         wait = self._timeout_s if timeout_s is None else timeout_s

@@ -120,9 +120,16 @@ def inject(
     from .. import storage_plugin as _sp
 
     ctl = controller if controller is not None else FaultController(schedule)
+    prev = None
 
     def _wrap(plugin: StoragePlugin, url: str) -> StoragePlugin:
-        return FaultPlugin(plugin, ctl)
+        # Chain over any previously installed wrap hook (the hot tier's
+        # TieredPlugin in particular) instead of shadowing it: faults
+        # then strike the composed stack — Fault(Tiered(backend)) when
+        # the tier was enabled first — so tier-down writes and hot-tier
+        # op boundaries are inside the injection domain too.
+        base = plugin if prev is None else prev(plugin, url)
+        return FaultPlugin(base, ctl)
 
     prev = _sp.set_plugin_wrap_hook(_wrap)
     add_storage_op_hook(ctl.on_subop)

@@ -25,6 +25,10 @@ Fault kinds:
 - **latency** — a sleep before the op proceeds.
 - **crash** — :class:`SimulatedCrash` from this boundary onward, forever
   (a dead process never comes back).
+- **host loss** — a hot-tier peer host is preempted at a deterministic
+  op boundary (its RAM replicas vanish; ``hottier.kill_host``); the op
+  stream continues and the loss surfaces wherever the tier next touches
+  the dead host.
 
 The schedule is deterministic by construction: rules fire on the *n*-th
 match of their (op-glob, path-glob) pattern, and the crash point on a
@@ -91,6 +95,7 @@ class FaultRule:
     matching ``(op, path)`` globs (1-based; ``times=None`` = forever)."""
 
     kind: str  # "transient" | "permanent" | "torn" | "latency" | "crash"
+    #          | "hostloss"
     op: str = "*"
     path: str = "*"
     nth: int = 1
@@ -99,6 +104,7 @@ class FaultRule:
     seconds: float = 0.0
     torn: Optional[TornWrite] = None
     error_factory: Optional[Callable[[str, str], Exception]] = None
+    host: Optional[int] = None  # hostloss: which peer host dies
     _hits: int = field(default=0, repr=False)
     _fired: int = field(default=0, repr=False)
 
@@ -227,6 +233,23 @@ class FaultSchedule:
         )
         return self
 
+    def lose_host(
+        self, host: int, op: str = "*", path: str = "*", nth: int = 1
+    ) -> "FaultSchedule":
+        """Preempt hot-tier peer ``host`` at the ``nth`` op matching the
+        globs: its RAM store is dropped wholesale and it goes dead
+        (``hottier.kill_host``), at a deterministic boundary of the op
+        stream — the host-loss half of the tier-down fault matrix. The
+        op itself then proceeds; the loss is observed by whichever
+        replica read/drain touches the dead host next."""
+        self.rules.append(
+            FaultRule(
+                kind="hostloss", op=op, path=path, nth=nth, times=1,
+                host=host,
+            )
+        )
+        return self
+
 
 @dataclass
 class FaultRecord:
@@ -291,6 +314,12 @@ class FaultController:
                 if rule.kind == "latency":
                     self._record(idx, op, path, "latency")
                     sleep_s += rule.seconds
+                    continue
+                if rule.kind == "hostloss":
+                    self._record(idx, op, path, "hostloss")
+                    from ..hottier import kill_host
+
+                    kill_host(rule.host)
                     continue
                 if rule.kind == "crash":
                     self.crashed = True

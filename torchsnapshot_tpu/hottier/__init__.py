@@ -1,0 +1,85 @@
+"""snaptier: preemption-tolerant hot checkpoint tier.
+
+``async_take`` acknowledges once each rank's objects are k-replicated
+in peer hosts' RAM; a background drain tiers them down to the durable
+plugin and records a ``.tierdown`` watermark beside the manifest;
+``restore`` prefers the (fingerprint-verified) hot tier and falls back
+per-object to the durable tier when peers are dead, stale, or corrupt —
+so a preempted job restores at RAM speed instead of storage speed, and
+any k-1 simultaneous host losses still restore bit-exact.
+
+Quickstart::
+
+    from torchsnapshot_tpu import hottier
+
+    hottier.enable_hot_tier()          # k from TPUSNAPSHOT_HOT_TIER_K,
+                                       # per-host RAM cap from
+                                       # TPUSNAPSHOT_HOT_TIER_BYTES
+    pending = Snapshot.async_take(path, app_state)   # acks at RAM speed
+    ...
+    snapshot.restore(app_state)        # served from peer RAM when hot
+
+Layering and the failure model are documented in runtime.py/tier.py;
+docs/FAULTS.md covers the host-loss schedules and the tier-down crash
+matrix, docs/OBSERVABILITY.md the tier metrics, the flight report's
+``tier`` block, the ledger field, and the ``hot-tier-degraded`` doctor
+rule.
+"""
+
+from .plugin import TieredPlugin
+from .runtime import (
+    BYTES_ENV_VAR,
+    K_ENV_VAR,
+    TIERDOWN_FNAME,
+    HotTierRuntime,
+    disable_hot_tier,
+    drain_now,
+    enable_hot_tier,
+    forget_root,
+    hot_tier,
+    is_enabled,
+    is_payload_path,
+    reconcile_hot_tier,
+    reset_pending,
+    restore_stats_begin,
+    restore_stats_collect,
+    runtime,
+    wait_drained,
+)
+from .tier import (
+    HostLostError,
+    buffered_roots,
+    kill_host,
+    live_hosts,
+    reset_hot_tier,
+    revive_host,
+    total_buffered_bytes,
+)
+
+__all__ = [
+    "BYTES_ENV_VAR",
+    "HostLostError",
+    "HotTierRuntime",
+    "K_ENV_VAR",
+    "TIERDOWN_FNAME",
+    "TieredPlugin",
+    "buffered_roots",
+    "disable_hot_tier",
+    "drain_now",
+    "enable_hot_tier",
+    "forget_root",
+    "hot_tier",
+    "is_enabled",
+    "is_payload_path",
+    "kill_host",
+    "live_hosts",
+    "reconcile_hot_tier",
+    "reset_hot_tier",
+    "reset_pending",
+    "restore_stats_begin",
+    "restore_stats_collect",
+    "revive_host",
+    "runtime",
+    "total_buffered_bytes",
+    "wait_drained",
+]

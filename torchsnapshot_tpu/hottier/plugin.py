@@ -1,0 +1,141 @@
+"""TieredPlugin: the hot tier as a composable StoragePlugin decorator.
+
+Installed by :func:`~.runtime.enable_hot_tier` through the same
+``set_plugin_wrap_hook`` seam faultline uses (hooks chain, so the two
+compose in either order); ``url_to_storage_plugin`` then wraps the
+result in the retry layer as usual::
+
+    RetryingStoragePlugin( [FaultPlugin(] TieredPlugin( backend ) [)] )
+
+Routing:
+
+- **payload objects** (``<rank>/…``, ``replicated/…``, ``chunked/…``)
+  write into peer-host RAM, k-replicated, and ACK without touching the
+  durable tier; the runtime's drainer persists them in the background
+  and records the ``.tierdown`` watermark (runtime.py). Reads prefer a
+  fingerprint-verified hot replica and fall back per-object to the
+  durable tier, counting the degradation.
+- **control plane** (anything dot-prefixed — metadata, completion
+  markers, step markers, reports, progress, the ledger, ``.tierdown``
+  itself — plus ``refs/`` back-links and ``@base…`` references) writes
+  through synchronously: these ARE the commit protocol, and the
+  metadata-last durability ordering they implement is exactly what the
+  tier must not perturb. The metadata write doubles as the runtime's
+  commit signal for the root.
+
+``ensure_durable`` passes through untouched: under the hot tier it
+makes the *control plane* durable, while payload durability is the
+tier-down contract (ack-at-k-replicas, ``.tierdown`` when storage holds
+everything) — the documented relaxation this subsystem exists for.
+
+``list_prefix`` deliberately enumerates the DURABLE tier only: sweeps
+and reconcile reason about storage objects; hot-only buffers are
+reconciled through :func:`~.runtime.reconcile_hot_tier`'s own
+accounting, never by pretending RAM is storage.
+"""
+
+from typing import Optional
+
+from ..io_types import IOReq, StoragePlugin, io_payload, is_not_found_error
+from .runtime import (
+    HotTierRuntime,
+    _METADATA_FNAME,
+    is_payload_path,
+)
+
+
+class TieredPlugin(StoragePlugin):
+    def __init__(
+        self, inner: StoragePlugin, runtime: HotTierRuntime, root: str
+    ) -> None:
+        self._inner = inner
+        self._runtime = runtime
+        self._root = root.rstrip("/")
+        self.max_write_concurrency = inner.max_write_concurrency
+        self.max_read_concurrency = inner.max_read_concurrency
+
+    async def write(self, io_req: IOReq) -> None:
+        rt = self._runtime
+        if not rt.active or not is_payload_path(io_req.path):
+            await self._inner.write(io_req)
+            if rt.active and io_req.path == _METADATA_FNAME:
+                # The commit point just landed: from here the take is
+                # visible, and once its pending objects drain the
+                # .tierdown watermark follows.
+                rt.on_commit(self._root)
+            return
+        payload = bytes(io_payload(io_req))
+        placed = rt.hot_put(self._root, io_req.path, payload)
+        if placed == 0:
+            # Every replica refused (capacity) or died: degrade to a
+            # synchronous durable write — slower, never less durable.
+            await self._inner.write(io_req)
+            rt.note_write_through(len(payload))
+            return
+        rt.enqueue_drain(self._root, io_req.path)
+
+    async def read(self, io_req: IOReq) -> None:
+        rt = self._runtime
+        if rt.active and is_payload_path(io_req.path):
+            data, attempted = rt.hot_get(
+                self._root, io_req.path, io_req.byte_range
+            )
+            if data is not None:
+                io_req.data = data
+                return
+            await self._inner.read(io_req)
+            if attempted:
+                # The hot tier knew this object and every replica was
+                # dead/missing/corrupt: a counted degraded fallback.
+                rt.note_fallback_bytes(len(io_payload(io_req)))
+            return
+        await self._inner.read(io_req)
+
+    async def delete(self, path: str) -> None:
+        rt = self._runtime
+        dropped = False
+        if rt.active and is_payload_path(path):
+            # Drop replicas AND cancel the pending drain first: a drain
+            # racing this delete must not resurrect the object into the
+            # durable tier after we removed it.
+            dropped = rt.forget_object(self._root, path)
+        try:
+            await self._inner.delete(path)
+        except Exception as e:
+            if dropped and is_not_found_error(e):
+                return  # the object lived only in the hot tier
+            raise
+
+    async def list_prefix(self, prefix: str):
+        return await self._inner.list_prefix(prefix)
+
+    async def object_age_s(self, path: str) -> Optional[float]:
+        try:
+            age = await self._inner.object_age_s(path)
+        except Exception as e:
+            if not is_not_found_error(e):
+                raise
+            age = None
+        if age is None and self._runtime.active and is_payload_path(path):
+            return self._runtime.object_age_s(self._root, path)
+        return age
+
+    async def object_size_bytes(self, path: str) -> Optional[int]:
+        try:
+            size = await self._inner.object_size_bytes(path)
+        except Exception as e:
+            if not is_not_found_error(e):
+                raise
+            size = None
+        if size is None and self._runtime.active and is_payload_path(path):
+            return self._runtime.object_size_bytes(self._root, path)
+        return size
+
+    def ensure_durable(self) -> None:
+        self._inner.ensure_durable()
+
+    def close(self) -> None:
+        # The drainer holds its own (bypassed) plugins; closing this one
+        # never blocks on tier-down — preemption tolerance means the
+        # foreground is free the moment the replicas are placed.
+        self._inner.close()

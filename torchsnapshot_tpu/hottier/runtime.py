@@ -1,0 +1,836 @@
+"""Hot-tier runtime: replication, ack, background tier-down, reconcile.
+
+The lifecycle the tiered backend implements (ROADMAP item 5):
+
+1. **replicate** — every payload object a take writes is placed,
+   k-replicated (``TPUSNAPSHOT_HOT_TIER_K``, default 2), into peer-host
+   RAM stores (tier.py). Placement is rendezvous-deterministic: rank
+   ``r``'s objects land on hosts ``r, r+1, … r+k-1 (mod world)``, the
+   rank/world identities coming from the coord layer.
+2. **ack** — the write returns once the replicas are placed; the take's
+   commit protocol (completion markers, metadata-last) proceeds
+   unchanged, so ``async_take`` acknowledges at RAM speed.
+3. **tier-down** — a drainer persists each object to the durable plugin
+   in the background and, once a committed root is fully drained,
+   records a ``.tierdown`` watermark next to the manifest. A replica
+   becomes evictable only after ITS durable write succeeded, so at
+   every instant every manifest-referenced byte exists in >= 1 tier —
+   the crash matrix enumerates every boundary of this pipeline
+   (``hottier.replicate`` / ``hottier.drain`` / ``hottier.tierdown``
+   op hooks) and proves it.
+4. **restore** — reads prefer the hot tier (fingerprint-verified per
+   object; see tier.py) and fall back per-object to the durable tier
+   when replicas are dead, missing, or corrupt; fallbacks are counted
+   and surface in the flight report / ledger / doctor
+   (``hot-tier-degraded``).
+
+Drain modes: ``"background"`` (production — a daemon thread drains as
+the take proceeds) and ``"manual"`` (the fault harness — tier-down runs
+synchronously via :func:`drain_now`, keeping faultline's op stream
+deterministic so crash points replay exactly).
+
+The durable plugin the drainer writes through is resolved via
+``url_to_storage_plugin`` with THIS module's wrap bypassed (thread-
+local), so it still passes every other installed wrapper — faultline's
+FaultPlugin in particular: injected faults and crash points strike the
+tier-down writes exactly as they would a foreground write, under the
+real retry policy.
+"""
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from .. import telemetry
+from ..coord import Coordinator, get_coordinator
+from ..io_types import IOReq, emit_storage_op, io_payload
+from ..storage_plugin import is_ref_location
+from ..telemetry import metrics as _metric_names
+from ..utils.env import env_float, env_int
+from . import tier
+
+logger = logging.getLogger(__name__)
+
+K_ENV_VAR = "TPUSNAPSHOT_HOT_TIER_K"
+_DEFAULT_K = 2
+BYTES_ENV_VAR = "TPUSNAPSHOT_HOT_TIER_BYTES"
+_DEFAULT_CAPACITY_BYTES = 1 << 30
+
+# The tier-down watermark, recorded next to the manifest once every
+# payload object of a committed take reached the durable tier. Dot-
+# prefixed (control plane): always written through, never hot-tiered.
+TIERDOWN_FNAME = ".tierdown"
+_METADATA_FNAME = ".snapshot_metadata"
+
+_DRAIN_MAX_ATTEMPTS = 3
+
+# Thread-local bypass: the drainer resolves the DURABLE plugin through
+# url_to_storage_plugin with the hot-tier wrap skipped (other wraps —
+# faultline — still apply); see module docstring.
+_BYPASS = threading.local()
+
+
+def is_payload_path(path: str) -> bool:
+    """Payload objects ride the hot tier; everything dot-prefixed
+    (metadata, markers, telemetry, reports, ``.tierdown``), incremental
+    back-link markers (``refs/``), and base references (``@base…``) are
+    control plane: written through to the durable tier synchronously —
+    they ARE the commit protocol and must obey its durability ordering."""
+    return not (
+        path.startswith(".")
+        or path.startswith("refs/")
+        or is_ref_location(path)
+    )
+
+
+class _RootState:
+    """Per-snapshot-root drain bookkeeping."""
+
+    def __init__(self) -> None:
+        self.pending: Set[str] = set()  # payload paths not yet durable
+        self.committed = False  # .snapshot_metadata observed
+        self.tierdown_done = False
+        self.drain_lost = 0  # objects whose every replica died pre-drain
+        # Items that exhausted their drain attempts: still pending (their
+        # hot replicas stay unevictable — the only copy), re-driven by
+        # the next drain_now(). wait_drained() reports them truthfully.
+        self.stranded: Set[str] = set()
+        self.tierdown_attempts = 0
+        self.tierdown_stranded = False
+
+
+class HotTierRuntime:
+    """One process's hot-tier brain: placement, stats, the drain queue."""
+
+    def __init__(
+        self,
+        rank: int,
+        world: int,
+        k: int,
+        capacity_bytes: int,
+        drain: str = "background",
+    ) -> None:
+        if drain not in ("background", "manual"):
+            raise ValueError(
+                f'drain must be "background" or "manual"; got {drain!r}'
+            )
+        self.rank = rank
+        self.world = max(1, world)
+        self.k = max(1, min(k, self.world))
+        self.capacity_bytes = capacity_bytes
+        self.drain_mode = drain
+        self.active = True
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: Deque[Tuple[str, Optional[str], int]] = deque()
+        self._roots: Dict[str, _RootState] = {}
+        self._inflight = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.drain_error: Optional[BaseException] = None
+        # Cumulative counters (stats_snapshot/delta power the per-restore
+        # tier summary; concurrent operations smear, same contract as the
+        # process-wide telemetry counters).
+        self._stats: Dict[str, int] = {
+            "hot_objects": 0,
+            "hot_bytes": 0,
+            "fallback_objects": 0,
+            "fallback_bytes": 0,
+            "replicas": 0,
+            "write_through": 0,
+            "drained_objects": 0,
+            "drained_bytes": 0,
+            "drain_lost": 0,
+        }
+        self._peer_failures: Dict[int, int] = {}
+        self._reason_counts: Dict[str, int] = {}
+
+    # ---------------------------------------------------------- placement
+
+    def replica_hosts(self) -> List[int]:
+        """This rank's replica set: itself plus the next k-1 hosts in
+        ring order — deterministic from (rank, world, k) alone, the same
+        information every peer derives from the coord rendezvous."""
+        return [(self.rank + i) % self.world for i in range(self.k)]
+
+    @staticmethod
+    def _key(root: str, path: str) -> str:
+        return f"{root.rstrip('/')}/{path}"
+
+    # -------------------------------------------------------- write side
+
+    def hot_put(self, root: str, path: str, payload: bytes) -> int:
+        """Replicate one payload object into peer RAM; returns how many
+        replicas were placed (0 = refused everywhere: caller degrades to
+        durable write-through). Each replica placement is a storage-op
+        boundary (``hottier.replicate``) so the crash-point enumerator
+        can strike between replicas."""
+        key = self._key(root, path)
+        tag = tier.payload_tag(payload)
+        placed = 0
+        for host in self.replica_hosts():
+            emit_storage_op("hottier.replicate", f"host{host}:{path}")
+            try:
+                if tier.put_replica(
+                    key, host, payload, tag, root.rstrip("/"),
+                    capacity_bytes=self.capacity_bytes,
+                ):
+                    placed += 1
+            except tier.HostLostError:
+                self._note_peer_failure(host, "dead")
+        if placed == 0:
+            # No replica landed: any stale replicas of an earlier object
+            # at this key must not survive a write they no longer match.
+            tier.forget_key(key)
+        with self._lock:
+            self._stats["replicas"] += placed
+        return placed
+
+    def note_write_through(self, nbytes: int) -> None:
+        with self._lock:
+            self._stats["write_through"] += 1
+        telemetry.counter(_metric_names.HOT_TIER_WRITE_THROUGH).inc()
+
+    def enqueue_drain(self, root: str, path: str) -> None:
+        root = root.rstrip("/")
+        with self._cond:
+            state = self._roots.setdefault(root, _RootState())
+            if path in state.pending:
+                return  # retried write of the same object: already queued
+            state.pending.add(path)
+            self._queue.append((root, path, 0))
+            self._cond.notify_all()
+        if self.drain_mode == "background":
+            self._ensure_thread()
+
+    def on_commit(self, root: str) -> None:
+        """The root's metadata document was written (the take's commit
+        point). Once its pending set drains empty, the ``.tierdown``
+        watermark goes down; a root that committed with nothing pending
+        (all write-through, or drained already) gets a watermark-only
+        queue item."""
+        root = root.rstrip("/")
+        with self._cond:
+            state = self._roots.setdefault(root, _RootState())
+            state.committed = True
+            if not state.pending and not state.tierdown_done:
+                self._queue.append((root, None, 0))
+                self._cond.notify_all()
+        if self.drain_mode == "background":
+            self._ensure_thread()
+
+    # --------------------------------------------------------- read side
+
+    def hot_get(
+        self, root: str, path: str, byte_range: Optional[tuple]
+    ) -> Tuple[Optional[bytes], bool]:
+        """``(payload, attempted)``: the object from the first healthy
+        replica, fingerprint-verified — or ``(None, attempted)`` where
+        ``attempted`` says whether the hot tier KNEW this object (and
+        every replica failed: a genuine degraded fallback) vs. never saw
+        it (a cold read that must not count as degradation)."""
+        key = self._key(root, path)
+        hosts = tier.replica_hosts_for(key)
+        if not hosts:
+            return None, False
+        # Prefer the local host's replica (no network hop in production).
+        ordered = sorted(hosts, key=lambda h: h != self.rank)
+        for host in ordered:
+            try:
+                obj = tier.get_replica(key, host)
+            except tier.HostLostError:
+                self._note_peer_failure(host, "dead")
+                continue
+            except KeyError:
+                self._note_peer_failure(host, "missing")
+                continue
+            if tier.payload_tag(obj.data) != obj.tag:
+                # Corrupt replica: drop it so nothing reads it again.
+                self._note_peer_failure(host, "corrupt")
+                tier.drop_replica(key, host)
+                continue
+            data = obj.data
+            if byte_range is not None:
+                start, end = byte_range
+                data = data[start:end]
+            with self._lock:
+                self._stats["hot_objects"] += 1
+                self._stats["hot_bytes"] += len(data)
+            telemetry.counter(_metric_names.HOT_TIER_READS, tier="hot").inc()
+            telemetry.counter(
+                _metric_names.HOT_TIER_READ_BYTES, tier="hot"
+            ).inc(len(data))
+            return data, True
+        with self._lock:
+            self._stats["fallback_objects"] += 1
+        telemetry.counter(
+            _metric_names.HOT_TIER_READS, tier="durable"
+        ).inc()
+        return None, True
+
+    def note_fallback_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self._stats["fallback_bytes"] += nbytes
+        telemetry.counter(
+            _metric_names.HOT_TIER_READ_BYTES, tier="durable"
+        ).inc(nbytes)
+
+    def _note_peer_failure(self, host: int, reason: str) -> None:
+        with self._lock:
+            self._peer_failures[host] = self._peer_failures.get(host, 0) + 1
+            self._reason_counts[reason] = (
+                self._reason_counts.get(reason, 0) + 1
+            )
+        telemetry.counter(
+            _metric_names.HOT_TIER_FALLBACKS, reason=reason
+        ).inc()
+
+    # -------------------------------------------------- delete/reconcile
+
+    def forget_object(self, root: str, path: str) -> bool:
+        """Drop every replica of one object and cancel its pending drain
+        (a deleted object must never be resurrected into the durable
+        tier by a later drain). True if the hot tier held it."""
+        key = self._key(root, path)
+        existed = tier.forget_key(key)
+        root = root.rstrip("/")
+        with self._cond:
+            state = self._roots.get(root)
+            if state is not None and path in state.pending:
+                state.pending.discard(path)
+                self._queue = deque(
+                    item
+                    for item in self._queue
+                    if not (item[0] == root and item[1] == path)
+                )
+                existed = True
+                self._cond.notify_all()
+        return existed
+
+    def forget_root(self, root: str) -> int:
+        """Drop every buffered object of ``root`` and cancel its drains
+        (``Snapshot.delete`` / prune). Returns objects dropped."""
+        root = root.rstrip("/")
+        dropped = 0
+        for key in tier.keys_for_root(root):
+            if tier.forget_key(key):
+                dropped += 1
+        with self._cond:
+            self._roots.pop(root, None)
+            self._queue = deque(
+                item for item in self._queue if item[0] != root
+            )
+            self._cond.notify_all()
+        return dropped
+
+    def object_age_s(self, root: str, path: str) -> Optional[float]:
+        return tier.key_age_s(self._key(root, path))
+
+    def object_size_bytes(self, root: str, path: str) -> Optional[int]:
+        return tier.key_size_bytes(self._key(root, path))
+
+    # -------------------------------------------------------- drain side
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self.drain_error is not None:
+                # A crashed drainer stays crashed (the fault model:
+                # process death); wait_drained() reports it and only an
+                # explicit reset_pending()/new runtime clears it.
+                return
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._drain_loop, name="tpusnapshot-hottier-drain",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(timeout=0.2)
+                if self._stop and not self._queue:
+                    return
+                root, path, attempts = self._queue.popleft()
+                self._inflight += 1
+            try:
+                self._drain_item(root, path, attempts)
+            except Exception as e:
+                # Per-item failures (e.g. a transient .tierdown write
+                # error) must not kill the drainer — the item's own
+                # requeue/leave-pending handling already ran; later
+                # items (or drain_now) re-drive what's left.
+                logger.warning(f"hot-tier drain item failed: {e!r}")
+            except BaseException as e:  # a crashed drainer stays crashed
+                self.drain_error = e
+                logger.warning(f"hot-tier drain died: {e!r}")
+                return  # inflight released by the finally below
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _requeue_stranded(self) -> None:
+        """Move every stranded object/watermark back into the queue with
+        fresh attempt budgets — drain_now()'s re-drive of work that
+        exhausted its attempts (a backend outage that outlasted the
+        retry layer)."""
+        with self._cond:
+            for root, state in self._roots.items():
+                for path in sorted(state.stranded):
+                    self._queue.append((root, path, 0))
+                state.stranded.clear()
+                if state.tierdown_stranded:
+                    state.tierdown_stranded = False
+                    state.tierdown_attempts = 0
+                    self._queue.append((root, None, 0))
+            self._cond.notify_all()
+
+    def drain_now(self) -> None:
+        """Synchronous tier-down of everything pending — including
+        re-driving stranded items (manual mode and tests; also usable to
+        force-flush a background drainer). Runs on the caller's thread
+        so faultline's op stream stays deterministic; a SimulatedCrash
+        propagates to the caller like any crash."""
+        self._requeue_stranded()
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+                root, path, attempts = self._queue.popleft()
+                self._inflight += 1
+            try:
+                self._drain_item(root, path, attempts)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _durable_plugin(self, root: str):
+        from ..storage_plugin import url_to_storage_plugin
+
+        _BYPASS.active = True
+        try:
+            return url_to_storage_plugin(root)
+        finally:
+            _BYPASS.active = False
+
+    def _drain_item(
+        self, root: str, path: Optional[str], attempts: int
+    ) -> None:
+        plugin = self._durable_plugin(root)
+        try:
+            if path is not None:
+                self._drain_object(plugin, root, path, attempts)
+            self._maybe_tierdown(plugin, root)
+        finally:
+            plugin.close()
+
+    def _drain_object(
+        self, plugin: Any, root: str, path: str, attempts: int
+    ) -> None:
+        key = self._key(root, path)
+        data: Optional[bytes] = None
+        for host in tier.replica_hosts_for(key) or []:
+            try:
+                obj = tier.get_replica(key, host)
+            except (tier.HostLostError, KeyError):
+                continue
+            if tier.payload_tag(obj.data) == obj.tag:
+                data = obj.data
+                break
+        if data is None:
+            # Every replica died before tier-down: the bytes are gone.
+            # The loss is counted and the pending entry retired — the
+            # root can never tier down clean, and a restore of this
+            # object will fail loudly at the durable tier (detect, not
+            # silent corruption).
+            logger.warning(
+                f"hot-tier drain: every replica of {key} lost before "
+                f"tier-down; the object was never persisted"
+            )
+            with self._cond:
+                self._stats["drain_lost"] += 1
+                state = self._roots.get(root)
+                if state is not None:
+                    state.pending.discard(path)
+                    state.drain_lost += 1
+            return
+        emit_storage_op("hottier.drain", path)
+        try:
+            asyncio.run(plugin.write(IOReq(path=path, data=data)))
+        except Exception as e:
+            if attempts + 1 < _DRAIN_MAX_ATTEMPTS:
+                with self._cond:
+                    self._queue.append((root, path, attempts + 1))
+                    self._cond.notify_all()
+                logger.warning(
+                    f"hot-tier drain of {key} failed "
+                    f"(attempt {attempts + 1}): {e!r}; requeued"
+                )
+                return
+            # Out of attempts: the object stays pending AND is marked
+            # stranded — its hot replicas stay unevictable (the only
+            # copy), wait_drained() reports the root un-flushed, and the
+            # next drain_now() re-drives it; the root's .tierdown is
+            # withheld, which is the truthful state.
+            with self._cond:
+                state = self._roots.get(root)
+                if state is not None:
+                    state.stranded.add(path)
+                self._cond.notify_all()
+            logger.warning(
+                f"hot-tier drain of {key} failed permanently: {e!r}; "
+                f"object remains hot-tier-only (re-driven by the next "
+                f"drain_now; no .tierdown until it lands)"
+            )
+            return
+        tier.mark_drained(key)
+        with self._cond:
+            self._stats["drained_objects"] += 1
+            self._stats["drained_bytes"] += len(data)
+            state = self._roots.get(root)
+            if state is not None:
+                state.pending.discard(path)
+        telemetry.counter(_metric_names.HOT_TIER_DRAINED_BYTES).inc(
+            len(data)
+        )
+
+    def _maybe_tierdown(self, plugin: Any, root: str) -> None:
+        with self._cond:
+            state = self._roots.get(root)
+            ready = (
+                state is not None
+                and state.committed
+                and not state.pending
+                and not state.tierdown_done
+                and state.drain_lost == 0
+            )
+            if not ready:
+                return
+        emit_storage_op("hottier.tierdown", TIERDOWN_FNAME)
+        doc = {
+            "format_version": 1,
+            "drained_objects": self._stats["drained_objects"],
+            "ts_epoch_s": round(time.time(), 3),
+        }
+        try:
+            asyncio.run(
+                plugin.write(
+                    IOReq(
+                        path=TIERDOWN_FNAME,
+                        data=json.dumps(doc, sort_keys=True).encode("utf-8"),
+                    )
+                )
+            )
+        except Exception as e:
+            # A failed watermark write must leave a re-drive trigger: the
+            # root is fully drained, so no object item will ever call
+            # back here — requeue the watermark-only sentinel (bounded
+            # attempts, then stranded for the next drain_now()).
+            with self._cond:
+                state = self._roots.get(root)
+                if state is not None:
+                    state.tierdown_attempts += 1
+                    if state.tierdown_attempts < _DRAIN_MAX_ATTEMPTS:
+                        self._queue.append((root, None, 0))
+                    else:
+                        state.tierdown_stranded = True
+                self._cond.notify_all()
+            logger.warning(
+                f"hot-tier .tierdown write for {root} failed: {e!r}; "
+                f"will re-drive"
+            )
+            return
+        with self._cond:
+            state = self._roots.get(root)
+            if state is not None:
+                state.tierdown_done = True
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout_s: float = 120.0) -> bool:
+        """Block until the drain queue is empty and nothing is in
+        flight; True only on a genuinely clean flush — False on timeout,
+        a dead drainer, or STRANDED work (objects/watermarks that
+        exhausted their attempts and await a drain_now() re-drive):
+        claiming success while committed bytes are still hot-tier-only
+        would let a caller tear the tier down over the only copy."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._queue or self._inflight:
+                if self.drain_error is not None:
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(0.2, remaining))
+            return not any(
+                s.stranded or s.tierdown_stranded
+                for s in self._roots.values()
+            )
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
+
+    def root_state(self, root: str) -> Optional[_RootState]:
+        with self._lock:
+            return self._roots.get(root.rstrip("/"))
+
+    # ------------------------------------------------------------- stats
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            snap: Dict[str, Any] = dict(self._stats)
+            snap["peers"] = dict(self._peer_failures)
+            snap["reasons"] = dict(self._reason_counts)
+            return snap
+
+
+# ---------------------------------------------------------- process-global
+
+_RUNTIME: Optional[HotTierRuntime] = None
+_PREV_HOOK: Any = None
+_ENABLE_LOCK = threading.Lock()
+
+
+def runtime() -> Optional[HotTierRuntime]:
+    return _RUNTIME
+
+
+def is_enabled() -> bool:
+    return _RUNTIME is not None and _RUNTIME.active
+
+
+def enable_hot_tier(
+    rank: Optional[int] = None,
+    world: Optional[int] = None,
+    k: Optional[int] = None,
+    capacity_bytes: Optional[int] = None,
+    drain: str = "background",
+    coord: Optional[Coordinator] = None,
+) -> HotTierRuntime:
+    """Turn the hot tier on process-wide: every storage plugin resolved
+    from here on is wrapped in a :class:`~.plugin.TieredPlugin` (the
+    same ``set_plugin_wrap_hook`` seam faultline uses; hooks chain, so
+    enabling inside a faultline ``inject`` block — or vice versa —
+    composes). ``rank``/``world`` default to the coord layer's identity
+    (``jax.distributed`` on a pod, single-host otherwise); ``k`` and
+    ``capacity_bytes`` default to ``TPUSNAPSHOT_HOT_TIER_K`` (2) and
+    ``TPUSNAPSHOT_HOT_TIER_BYTES`` (1 GiB per host)."""
+    global _RUNTIME, _PREV_HOOK
+    from .. import storage_plugin as _sp
+    from .plugin import TieredPlugin
+
+    with _ENABLE_LOCK:
+        if _RUNTIME is not None:
+            raise RuntimeError(
+                "hot tier is already enabled; disable_hot_tier() first"
+            )
+        if rank is None or world is None:
+            coordinator = get_coordinator(coord)
+            rank = coordinator.get_rank() if rank is None else rank
+            world = (
+                coordinator.get_world_size() if world is None else world
+            )
+        rt = HotTierRuntime(
+            rank=rank,
+            world=world,
+            k=k if k is not None else env_int(K_ENV_VAR, _DEFAULT_K),
+            capacity_bytes=(
+                capacity_bytes
+                if capacity_bytes is not None
+                else env_int(BYTES_ENV_VAR, _DEFAULT_CAPACITY_BYTES)
+            ),
+            drain=drain,
+        )
+
+        def _hook(plugin, url):
+            base = (
+                _PREV_HOOK(plugin, url) if _PREV_HOOK is not None else plugin
+            )
+            if getattr(_BYPASS, "active", False):
+                return base  # drainer: durable tier, faults still apply
+            return TieredPlugin(base, rt, url)
+
+        _PREV_HOOK = _sp.set_plugin_wrap_hook(_hook)
+        _RUNTIME = rt
+        return rt
+
+
+def disable_hot_tier(flush: bool = True, timeout_s: float = 120.0) -> None:
+    """Uninstall the hot tier (LIFO with any other wrap-hook users, like
+    faultline's ``inject``). ``flush=True`` drains everything pending
+    first so no committed bytes are stranded hot-only; plugins already
+    resolved keep their wrapper but it deactivates (pass-through)."""
+    global _RUNTIME, _PREV_HOOK
+    from .. import storage_plugin as _sp
+
+    with _ENABLE_LOCK:
+        rt = _RUNTIME
+        if rt is None:
+            return
+        if flush:
+            if rt.drain_mode == "manual":
+                rt.drain_now()
+            else:
+                rt._ensure_thread()
+                if not rt.wait_drained(timeout_s=timeout_s):
+                    logger.warning(
+                        "disable_hot_tier: drain did not flush within "
+                        f"{timeout_s:g}s; undrained objects remain "
+                        f"hot-tier-only"
+                    )
+        rt.stop()
+        rt.active = False
+        _sp.set_plugin_wrap_hook(_PREV_HOOK)
+        _PREV_HOOK = None
+        _RUNTIME = None
+
+
+@contextmanager
+def hot_tier(**kwargs):
+    """``with hot_tier(world=4, k=2): ...`` — enable/disable scoped."""
+    rt = enable_hot_tier(**kwargs)
+    try:
+        yield rt
+    finally:
+        disable_hot_tier()
+
+
+# ------------------------------------------------------- module-level API
+
+
+def drain_now() -> None:
+    rt = _RUNTIME
+    if rt is not None:
+        rt.drain_now()
+
+
+def wait_drained(timeout_s: float = 120.0) -> bool:
+    rt = _RUNTIME
+    return rt.wait_drained(timeout_s=timeout_s) if rt is not None else True
+
+
+def reset_pending() -> None:
+    """Drop ALL drain bookkeeping (queue + per-root state + a dead
+    drainer's error latch) without touching the stores — the fault
+    harness's fresh-context hook: each crash-point replay starts from an
+    empty op-relevant queue so the enumerated op stream is identical
+    across replays."""
+    rt = _RUNTIME
+    if rt is None:
+        return
+    with rt._cond:
+        rt._queue.clear()
+        rt._roots.clear()
+        rt.drain_error = None
+        rt._cond.notify_all()
+
+
+def forget_root(root: str) -> int:
+    """Drop every hot replica of ``root`` and cancel its pending drains
+    (``Snapshot.delete``/prune hook). Works with the runtime disabled
+    too — registry-level state must not outlive its snapshot."""
+    rt = _RUNTIME
+    if rt is not None:
+        return rt.forget_root(root)
+    dropped = 0
+    for key in tier.keys_for_root(root):
+        if tier.forget_key(key):
+            dropped += 1
+    return dropped
+
+
+def reconcile_hot_tier(
+    base_path: str,
+    keep_roots: Set[str],
+    min_age_s: Optional[float] = None,
+) -> List[str]:
+    """Sweep orphaned hot-tier buffers under ``base_path``: roots not in
+    ``keep_roots`` (the manager passes every step with committed
+    metadata OR a step marker — so a committed-but-not-yet-drained
+    take's replicas are structurally unreachable by this sweep) whose
+    buffers have aged past the ``TPUSNAPSHOT_SWEEP_MIN_AGE_S`` guard,
+    the same knob and fail-closed posture as every storage sweep.
+    Returns the roots whose buffers were dropped."""
+    if min_age_s is None:
+        min_age_s = env_float("TPUSNAPSHOT_SWEEP_MIN_AGE_S", 3600.0)
+    base = base_path.rstrip("/")
+    keep = {r.rstrip("/") for r in keep_roots}
+    dropped: List[str] = []
+    for root, _nbytes in sorted(tier.buffered_roots().items()):
+        if not (root == base or root.startswith(base + "/")):
+            continue
+        if root in keep:
+            continue
+        if min_age_s > 0:
+            ages = [
+                tier.key_age_s(key) for key in tier.keys_for_root(root)
+            ]
+            known = [a for a in ages if a is not None]
+            # Fail closed: unknown age (or any young object) spares the
+            # whole root — it may be an in-flight take's buffers.
+            if not known or min(known) < min_age_s:
+                continue
+        forget_root(root)
+        dropped.append(root)
+    return dropped
+
+
+def restore_stats_begin() -> Optional[Dict[str, Any]]:
+    """Token for per-restore tier attribution (None = tier disabled)."""
+    rt = _RUNTIME
+    return rt.stats_snapshot() if rt is not None and rt.active else None
+
+
+def restore_stats_collect(
+    token: Optional[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """The tier summary for the restore since ``token``: hot/fallback
+    object+byte counts, the peers that failed, and why — the dict the
+    flight report carries as ``tier`` and the ``hot-tier-degraded``
+    doctor rule reads. None when the tier is off or saw no traffic."""
+    rt = _RUNTIME
+    if token is None or rt is None:
+        return None
+    now = rt.stats_snapshot()
+
+    def _d(field: str) -> int:
+        return int(now.get(field, 0)) - int(token.get(field, 0))
+
+    summary = {
+        "hot_objects": _d("hot_objects"),
+        "hot_bytes": _d("hot_bytes"),
+        "fallback_objects": _d("fallback_objects"),
+        "fallback_bytes": _d("fallback_bytes"),
+    }
+    if not any(summary.values()):
+        return None
+    old_peers = token.get("peers") or {}
+    summary["degraded_peers"] = sorted(
+        h
+        for h, c in (now.get("peers") or {}).items()
+        if c > int(old_peers.get(h, 0))
+    )
+    old_reasons = token.get("reasons") or {}
+    reasons = {
+        r: c - int(old_reasons.get(r, 0))
+        for r, c in (now.get("reasons") or {}).items()
+        if c > int(old_reasons.get(r, 0))
+    }
+    if reasons:
+        summary["fallback_reasons"] = reasons
+    return summary

@@ -1,0 +1,319 @@
+"""Peer-host RAM stores: the storage substrate of the hot tier.
+
+A *host* here is a failure domain that can be preempted as a unit — in
+production one TPU worker host, in tests a virtual host id. Each host
+exposes one :class:`HostRamStore`: a byte-capped in-RAM object store
+holding hot replicas of recently taken snapshot objects. The rendezvous
+index (``key → replica hosts``) records where each object's k replicas
+landed so a reader probes exactly the hosts that hold it.
+
+This module is deliberately transport-agnostic: in-process, the
+"stores" are plain dicts (each virtual host a separate failure domain
+the tests can kill independently); on a multi-host pod the same
+interface is what a coord-layer (DCN KV / RDMA) transport implements —
+the runtime only ever speaks ``put/get/drop`` plus the index. The
+failure model the harness exercises — :func:`kill_host` drops a host's
+RAM wholesale, exactly what preemption does — is identical either way.
+
+Integrity: every object carries an xs128 content fingerprint
+(fingerprint.py — the same algorithm that gates incremental dedup)
+computed at put time over the exact payload bytes; ``get`` recomputes
+and compares, so a corrupt replica is detected at the tier boundary and
+the reader falls over to the next replica (or the durable tier) instead
+of handing garbage to the consume path.
+
+Eviction: only *drained* objects (already persisted to the durable
+tier) are evictable, LRU per host. An undrained object is the only copy
+of committed bytes outside its k-replica set — evicting it could leave
+a manifest referencing bytes that exist in no tier, the exact invariant
+the crash matrix proves we never violate. A put that cannot fit even
+after evicting drained objects is *refused*; the caller degrades to a
+synchronous durable write-through.
+
+One module-wide lock guards hosts + objects + index: the structures are
+tiny (metadata, not payload copies beyond the stored bytes) and a
+single lock makes the cross-structure invariants (index entries always
+name live replicas) trivially atomic.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import telemetry
+from ..fingerprint import fingerprint_host
+from ..telemetry import metrics as _metric_names
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class HostLostError(RuntimeError):
+    """The addressed peer host is dead (preempted / unreachable)."""
+
+
+def payload_tag(data) -> str:
+    """Content fingerprint of raw payload bytes (xs128, fingerprint.py)."""
+    return fingerprint_host(bytes(data))
+
+
+@dataclass
+class HotObject:
+    data: bytes
+    tag: str  # xs128 fingerprint of ``data`` at put time
+    root: str  # snapshot root this object belongs to (reconcile grouping)
+    put_t: float  # epoch seconds
+    drained: bool = False  # persisted to the durable tier
+
+
+class HostRamStore:
+    """One host's RAM store. All mutation happens under ``_TIER_LOCK``
+    (module-wide); the class only encapsulates per-host state."""
+
+    def __init__(self, host_id: int, capacity_bytes: int) -> None:
+        self.host_id = host_id
+        self.capacity_bytes = capacity_bytes
+        self.alive = True
+        self.objects: "OrderedDict[str, HotObject]" = OrderedDict()
+        self.used_bytes = 0
+
+
+_TIER_LOCK = threading.RLock()
+_HOSTS: Dict[int, HostRamStore] = {}
+# Rendezvous index: key -> hosts holding a replica (in placement order).
+_KEY_HOSTS: Dict[str, List[int]] = {}
+
+
+def host_store(host_id: int, capacity_bytes: Optional[int] = None) -> HostRamStore:
+    with _TIER_LOCK:
+        store = _HOSTS.get(host_id)
+        if store is None:
+            store = HostRamStore(host_id, capacity_bytes or (1 << 30))
+            _HOSTS[host_id] = store
+        elif capacity_bytes is not None:
+            store.capacity_bytes = capacity_bytes
+        return store
+
+
+def kill_host(host_id: int) -> None:
+    """Simulate preemption: the host's RAM is gone and the host is dead.
+
+    Index entries are NOT cleaned — a reader discovers the death on
+    access (the ``dead`` fallback reason), exactly like a real
+    unreachable peer."""
+    with _TIER_LOCK:
+        store = host_store(host_id)
+        store.alive = False
+        store.objects.clear()
+        store.used_bytes = 0
+        _update_buffered_gauge()
+
+
+def revive_host(host_id: int) -> None:
+    """Bring a host back (empty — preemption lost its RAM)."""
+    with _TIER_LOCK:
+        host_store(host_id).alive = True
+
+
+def live_hosts() -> List[int]:
+    with _TIER_LOCK:
+        return sorted(h for h, s in _HOSTS.items() if s.alive)
+
+
+def reset_hot_tier() -> None:
+    """Drop every host, object, and index entry (tests)."""
+    with _TIER_LOCK:
+        _HOSTS.clear()
+        _KEY_HOSTS.clear()
+        _update_buffered_gauge()
+
+
+def _update_buffered_gauge() -> None:
+    # Lock held by caller.
+    telemetry.gauge(_metric_names.HOT_TIER_BUFFERED_BYTES).set(
+        float(sum(s.used_bytes for s in _HOSTS.values()))
+    )
+
+
+def _evict_for(store: HostRamStore, need: int) -> None:
+    """Free >= ``need`` bytes by evicting drained objects, oldest-touch
+    first. Undrained objects are never evicted (see module docstring);
+    the caller refuses the put if this cannot make room."""
+    if store.used_bytes + need <= store.capacity_bytes:
+        return
+    for key in list(store.objects):
+        if store.used_bytes + need <= store.capacity_bytes:
+            return
+        obj = store.objects[key]
+        if not obj.drained:
+            continue
+        del store.objects[key]
+        store.used_bytes -= len(obj.data)
+        _index_remove(key, store.host_id)
+        telemetry.counter(_metric_names.HOT_TIER_EVICTIONS).inc()
+
+
+def _index_remove(key: str, host_id: int) -> None:
+    hosts = _KEY_HOSTS.get(key)
+    if hosts is not None:
+        try:
+            hosts.remove(host_id)
+        except ValueError:
+            pass
+        if not hosts:
+            del _KEY_HOSTS[key]
+
+
+def put_replica(
+    key: str, host_id: int, data: bytes, tag: str, root: str,
+    capacity_bytes: Optional[int] = None,
+) -> bool:
+    """Place one replica on ``host_id``; returns False when refused for
+    capacity. Raises :class:`HostLostError` on a dead host. Replaces any
+    existing replica of ``key`` (a re-written object invalidates the old
+    bytes — stale replicas cannot survive a successful re-put)."""
+    with _TIER_LOCK:
+        store = host_store(host_id, capacity_bytes)
+        if not store.alive:
+            raise HostLostError(f"host {host_id} is dead")
+        old = store.objects.pop(key, None)
+        if old is not None:
+            store.used_bytes -= len(old.data)
+            _index_remove(key, host_id)
+        _evict_for(store, len(data))
+        if store.used_bytes + len(data) > store.capacity_bytes:
+            _update_buffered_gauge()
+            return False
+        store.objects[key] = HotObject(
+            data=bytes(data), tag=tag, root=root, put_t=time.time()
+        )
+        store.used_bytes += len(data)
+        hosts = _KEY_HOSTS.setdefault(key, [])
+        if host_id not in hosts:
+            hosts.append(host_id)
+        _update_buffered_gauge()
+        telemetry.counter(_metric_names.HOT_TIER_REPLICAS).inc()
+        return True
+
+
+def get_replica(key: str, host_id: int) -> HotObject:
+    """The replica on ``host_id`` — raises :class:`HostLostError` (dead
+    host) or ``KeyError`` (missing). Verifying the content tag is the
+    CALLER's job (the runtime counts corruption as a fallback reason)."""
+    with _TIER_LOCK:
+        store = _HOSTS.get(host_id)
+        if store is None or not store.alive:
+            raise HostLostError(f"host {host_id} is dead")
+        obj = store.objects[key]  # KeyError propagates: replica missing
+        store.objects.move_to_end(key)  # LRU touch
+        return obj
+
+
+def replica_hosts_for(key: str) -> Optional[List[int]]:
+    """The rendezvous answer: hosts that (claimed to) hold ``key``, in
+    placement order — or None for a key the hot tier never saw."""
+    with _TIER_LOCK:
+        hosts = _KEY_HOSTS.get(key)
+        return list(hosts) if hosts is not None else None
+
+
+def drop_replica(key: str, host_id: int) -> None:
+    """Remove one (e.g. corrupt) replica."""
+    with _TIER_LOCK:
+        store = _HOSTS.get(host_id)
+        if store is not None:
+            obj = store.objects.pop(key, None)
+            if obj is not None:
+                store.used_bytes -= len(obj.data)
+        _index_remove(key, host_id)
+        _update_buffered_gauge()
+
+
+def forget_key(key: str) -> bool:
+    """Drop every replica of ``key``; True if any existed."""
+    with _TIER_LOCK:
+        hosts = _KEY_HOSTS.pop(key, None)
+        existed = False
+        for h in hosts or []:
+            store = _HOSTS.get(h)
+            if store is None:
+                continue
+            obj = store.objects.pop(key, None)
+            if obj is not None:
+                store.used_bytes -= len(obj.data)
+                existed = True
+        _update_buffered_gauge()
+        return existed
+
+
+def mark_drained(key: str) -> None:
+    """Flag every replica of ``key`` as persisted (hence evictable)."""
+    with _TIER_LOCK:
+        for h in _KEY_HOSTS.get(key, []):
+            store = _HOSTS.get(h)
+            if store is not None:
+                obj = store.objects.get(key)
+                if obj is not None:
+                    obj.drained = True
+
+
+def key_age_s(key: str) -> Optional[float]:
+    """Seconds since the newest replica of ``key`` was put (None when no
+    replica survives) — the hot tier's analog of ``object_age_s``, used
+    by the same age-guarded sweeps."""
+    with _TIER_LOCK:
+        newest: Optional[float] = None
+        for h in _KEY_HOSTS.get(key, []):
+            store = _HOSTS.get(h)
+            obj = store.objects.get(key) if store is not None else None
+            if obj is not None and (newest is None or obj.put_t > newest):
+                newest = obj.put_t
+        return None if newest is None else max(0.0, time.time() - newest)
+
+
+def key_size_bytes(key: str) -> Optional[int]:
+    with _TIER_LOCK:
+        for h in _KEY_HOSTS.get(key, []):
+            store = _HOSTS.get(h)
+            obj = store.objects.get(key) if store is not None else None
+            if obj is not None:
+                return len(obj.data)
+        return None
+
+
+def buffered_roots() -> Dict[str, int]:
+    """``{snapshot_root: buffered_bytes}`` across all hosts — the
+    accounting the leak checks and reconcile sweeps fold over. Bytes are
+    summed over replicas (k copies of a root count k times)."""
+    with _TIER_LOCK:
+        out: Dict[str, int] = {}
+        for store in _HOSTS.values():
+            for obj in store.objects.values():
+                out[obj.root] = out.get(obj.root, 0) + len(obj.data)
+        return out
+
+
+def keys_for_root(root: str) -> List[str]:
+    """Every key whose object belongs to ``root`` (any host)."""
+    root = root.rstrip("/")
+    with _TIER_LOCK:
+        keys = set()
+        for store in _HOSTS.values():
+            for key, obj in store.objects.items():
+                if obj.root == root:
+                    keys.add(key)
+        # Index entries whose replicas all died still address the root
+        # by prefix (key = "<root>/<path>"): include them so forgetting
+        # a root also clears dead-host index residue.
+        for key in _KEY_HOSTS:
+            if key.startswith(root + "/"):
+                keys.add(key)
+        return sorted(keys)
+
+
+def total_buffered_bytes() -> int:
+    with _TIER_LOCK:
+        return sum(s.used_bytes for s in _HOSTS.values())

@@ -288,6 +288,7 @@ class CheckpointManager:
             )
             self._clean_torn_control_files(storage)
             self._clean_progress_debris(storage, objs)
+            self._reconcile_hot_tier(committed, marked, tombstoned)
             return handled
         finally:
             storage.close()
@@ -391,6 +392,33 @@ class CheckpointManager:
             if re.search(r"\.tmp\d+$", obj):
                 doomed.append(obj)
         self._sweep_aged_objects(storage, doomed, "torn control file")
+
+    def _reconcile_hot_tier(self, committed, marked, tombstoned) -> None:
+        """Sweep orphaned hot-tier RAM buffers (hottier/): steps with
+        neither committed metadata nor a step marker — a take that
+        crashed pre-commit, or a prune that already condemned the step
+        (tombstoned) — have buffers nothing will ever read or drain.
+        Keep-set = committed ∪ marked: a COMMITTED-but-not-yet-drained
+        take's replicas are structurally unreachable by this sweep (its
+        metadata is its commit point), so reconcile can never reclaim
+        bytes a restorable snapshot still needs; uncommitted young roots
+        are spared by the same ``TPUSNAPSHOT_SWEEP_MIN_AGE_S`` guard as
+        every storage sweep. Best-effort like all telemetry/tier
+        bookkeeping: a tier failure must never fail reconcile."""
+        try:
+            from . import hottier
+
+            keep = {
+                _step_dir(self.base_path, s)
+                for s in (set(committed) | set(marked)) - set(tombstoned)
+            }
+            for root in hottier.reconcile_hot_tier(self.base_path, keep):
+                logger.info(
+                    f"reconcile: dropped orphaned hot-tier buffers for "
+                    f"{root}"
+                )
+        except Exception as e:
+            logger.warning(f"reconcile: hot-tier buffer sweep failed: {e!r}")
 
     def _clean_progress_debris(self, storage: Any, objs) -> None:
         """Reclaim orphaned ``step-<N>/.progress/<take_id>/<rank>``

@@ -690,6 +690,14 @@ class Snapshot:
         watch.set_phase("restore")
         telemetry.counter(_metric_names.RESTORES_TOTAL).inc()
         read_stats: Dict[str, Any] = {}
+        # Hot-tier attribution (hottier/): which objects were served from
+        # peer RAM vs fell back to the durable tier, and which peers were
+        # degraded — the flight report's ``tier`` block, read by the
+        # hot-tier-degraded doctor rule and the ledger. Observability
+        # only: None whenever the tier is off.
+        from . import hottier as _hottier
+
+        tier_token = _hottier.restore_stats_begin()
 
         app_state = dict(app_state)
         rng_key, rng_stateful = _pop_rng_state(app_state)
@@ -735,6 +743,9 @@ class Snapshot:
                 progress=watch,
             )
         watch.finish()
+        tier_summary = _hottier.restore_stats_collect(tier_token)
+        if tier_summary is not None:
+            recorder.note(tier=tier_summary)
         self._finish_restore_report(
             recorder, read_stats, storage, rank, coordinator
         )
@@ -920,6 +931,12 @@ class Snapshot:
                     for r in range(metadata.world_size)
                     if metadata.take_id
                 ]
+            # The hot tier's tier-down watermark is ours too (inert
+            # once the snapshot is gone; explicit deletion keeps a
+            # sweep-less delete complete, like the reports below).
+            from .hottier.runtime import TIERDOWN_FNAME
+
+            markers = markers + [TIERDOWN_FNAME]
             # Our own back-link markers (refs/ in OUR prefix) go with us.
             from .incremental import REFS_PREFIX
 
@@ -1027,6 +1044,16 @@ class Snapshot:
                     asyncio.run(_gc_backlinks_in_bases(metadata, self.path))
                 except Exception as e:
                     logger.warning(f"back-link marker GC failed: {e!r}")
+            # Hot-tier replicas of this snapshot go with it — including
+            # any still-pending tier-down, which is CANCELED so a
+            # background drain can never resurrect a deleted snapshot's
+            # objects into the durable tier after the sweep.
+            try:
+                from . import hottier as _hottier
+
+                _hottier.forget_root(self.path)
+            except Exception as e:
+                logger.warning(f"hot-tier buffer GC failed: {e!r}")
         finally:
             storage.close()
 

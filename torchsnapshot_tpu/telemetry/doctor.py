@@ -31,6 +31,8 @@ imbalanced-stripe         max rank bytes >= 2x the rank median
 checkpoint-overhead-      goodput attribution shows checkpointing over
 above-budget              TPUSNAPSHOT_CKPT_BUDGET_PCT (default 5%)
 missing-rank-summary      a rank's summary never arrived (null)
+hot-tier-degraded         a restore fell back to the durable tier for
+                          >0 objects (critical when >50% of bytes)
 ========================  =============================================
 
 Findings are observability, not judgment: every rule errs toward
@@ -405,6 +407,66 @@ def _rule_missing_summary(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_hot_tier_degraded(report: Dict[str, Any]) -> Optional[Finding]:
+    """A restore that should have been served from peer RAM leaked reads
+    to the durable tier: >0 per-object fallbacks fire a warning, and a
+    majority of the BYTES falling back (the hot tier effectively absent —
+    preempted peers, corrupt replicas, an undersized
+    TPUSNAPSHOT_HOT_TIER_BYTES) is critical. Evidence names the degraded
+    peer hosts range-compressed, the same rendering as coord timeouts."""
+    from ..coord import format_rank_list
+
+    if report.get("kind") != "restore":
+        return None
+    tiers = [
+        s.get("tier") for s in _ranks(report) if s.get("tier")
+    ]
+    if not tiers:
+        return None
+    fallback_objects = sum(int(t.get("fallback_objects") or 0) for t in tiers)
+    if fallback_objects <= 0:
+        return None
+    fallback_bytes = sum(int(t.get("fallback_bytes") or 0) for t in tiers)
+    hot_bytes = sum(int(t.get("hot_bytes") or 0) for t in tiers)
+    total_bytes = hot_bytes + fallback_bytes
+    fraction = fallback_bytes / total_bytes if total_bytes > 0 else 1.0
+    peers = sorted(
+        {int(p) for t in tiers for p in (t.get("degraded_peers") or [])}
+    )
+    reasons: Dict[str, int] = {}
+    for t in tiers:
+        for r, c in (t.get("fallback_reasons") or {}).items():
+            reasons[r] = reasons.get(r, 0) + int(c)
+    return Finding(
+        rule="hot-tier-degraded",
+        severity="critical" if fraction > 0.5 else "warn",
+        title=(
+            f"restore fell back to the durable tier for "
+            f"{fallback_objects} object(s) "
+            f"({100 * fraction:.0f}% of bytes); degraded "
+            f"{format_rank_list(peers, noun='peer host')}"
+        ),
+        evidence={
+            "fallback_objects": fallback_objects,
+            "fallback_bytes": fallback_bytes,
+            "hot_bytes": hot_bytes,
+            "fallback_byte_fraction": round(fraction, 3),
+            "degraded_peers": format_rank_list(peers, noun="peer host"),
+            "reasons": reasons,
+        },
+        remediation=(
+            "the hot tier could not serve these objects: 'dead' peers "
+            "mean preempted/lost hosts (raise TPUSNAPSHOT_HOT_TIER_K if "
+            "losses exceed k-1), 'missing' means replicas were evicted "
+            "or never placed (raise TPUSNAPSHOT_HOT_TIER_BYTES), "
+            "'corrupt' means a replica failed its fingerprint check "
+            "(the fallback kept the restore correct; investigate the "
+            "host's RAM). Durable-tier restores are storage-speed — "
+            "expect minutes, not seconds, until the tier is healthy."
+        ),
+    )
+
+
 RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_consume_dominated,
     _rule_read_dominated,
@@ -415,6 +477,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_imbalanced_stripe,
     _rule_checkpoint_overhead,
     _rule_missing_summary,
+    _rule_hot_tier_degraded,
 ]
 
 _SEVERITY_ORDER = {"critical": 0, "warn": 1}

@@ -68,6 +68,10 @@ source operation did not produce them::
       "goodput": {...} | null,           # goodput.snapshot() at commit
       "churn": {"added_bytes", "unchanged_bytes", "removed_bytes",
                 "efficiency", "basis": "incremental" | "full"} | null,
+      "tier": {"hot_objects", "hot_bytes", "fallback_objects",
+               "fallback_bytes", "degraded_peers": [host, ...]} | null,
+                                         # hot-tier attribution (restores
+                                         # with the hot tier enabled)
       "doctor": ["<rule id>", ...]       # rules that fired on the report
     }
 """
@@ -463,6 +467,30 @@ def _churn_totals(
     }
 
 
+def _tier_totals(
+    summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank hot-tier blocks (hottier/) into the digest's
+    ``tier`` field. None when no rank recorded tier traffic (tier off,
+    or a take — only restores attribute tier reads)."""
+    noted = [s.get("tier") for s in summaries if s and s.get("tier")]
+    if not noted:
+        return None
+    return {
+        "hot_objects": sum(int(t.get("hot_objects") or 0) for t in noted),
+        "hot_bytes": sum(int(t.get("hot_bytes") or 0) for t in noted),
+        "fallback_objects": sum(
+            int(t.get("fallback_objects") or 0) for t in noted
+        ),
+        "fallback_bytes": sum(
+            int(t.get("fallback_bytes") or 0) for t in noted
+        ),
+        "degraded_peers": sorted(
+            {int(p) for t in noted for p in (t.get("degraded_peers") or [])}
+        ),
+    }
+
+
 def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
     """Fold a merged flight report (take or restore) into one ledger
     record. Runs the doctor over the report so the record carries the
@@ -507,5 +535,6 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "phases": _phase_max(summaries),
         "goodput": goodput,
         "churn": _churn_totals(summaries, nbytes),
+        "tier": _tier_totals(summaries),
         "doctor": doctor_rules,
     }

@@ -335,3 +335,22 @@ LEDGER_RECORDS_TOTAL = "tpusnapshot_ledger_records_total"  # counter {kind}
 LEDGER_APPEND_FAILURES = (
     "tpusnapshot_ledger_append_failures_total"  # counter
 )
+# Hot tier (hottier/): tier={hot|durable} on the read metrics; the
+# fallback counter's reason={dead|missing|corrupt} names why a replica
+# was unusable — all bounded label sets.
+HOT_TIER_READS = "tpusnapshot_hot_tier_reads_total"  # counter {tier}
+HOT_TIER_READ_BYTES = (
+    "tpusnapshot_hot_tier_read_bytes_total"  # counter {tier}
+)
+HOT_TIER_REPLICAS = "tpusnapshot_hot_tier_replicas_total"  # counter
+HOT_TIER_FALLBACKS = (
+    "tpusnapshot_hot_tier_fallbacks_total"  # counter {reason}
+)
+HOT_TIER_DRAINED_BYTES = (
+    "tpusnapshot_hot_tier_drained_bytes_total"  # counter
+)
+HOT_TIER_EVICTIONS = "tpusnapshot_hot_tier_evictions_total"  # counter
+HOT_TIER_WRITE_THROUGH = (
+    "tpusnapshot_hot_tier_write_through_total"  # counter
+)
+HOT_TIER_BUFFERED_BYTES = "tpusnapshot_hot_tier_buffered_bytes"  # gauge
