@@ -12,6 +12,8 @@ the host-loss x crash-point product are also marked ``slow``.
 """
 
 import json
+import threading
+import time
 import uuid
 
 import numpy as np
@@ -75,7 +77,7 @@ def _payload_objects(url):
     return [o for o in _durable_objects(url) if hottier.is_payload_path(o)]
 
 
-def _read_json(url, path):
+def _read_bytes(url, path):
     import asyncio
 
     from torchsnapshot_tpu.io_types import io_payload
@@ -84,9 +86,13 @@ def _read_json(url, path):
     try:
         io_req = IOReq(path=path)
         asyncio.run(storage.read(io_req))
-        return json.loads(bytes(io_payload(io_req)).decode("utf-8"))
+        return bytes(io_payload(io_req))
     finally:
         storage.close()
+
+
+def _read_json(url, path):
+    return json.loads(_read_bytes(url, path).decode("utf-8"))
 
 
 # ------------------------------------------------- ack / drain / watermark
@@ -278,7 +284,8 @@ def test_undrained_never_evicted_capacity_degrades_to_write_through():
         # the next put may displace it.
         hottier.drain_now()
         rt = hottier.runtime()
-        assert rt.hot_put(root, "0/extra/blob", b"x" * 4096) == 1
+        placed, _tag = rt.hot_put(root, "0/extra/blob", b"x" * 4096)
+        assert placed == 1
         assert ht_tier.total_buffered_bytes() <= 6000
 
 
@@ -546,3 +553,468 @@ def test_mid_replication_host_loss_during_take(monkeypatch):
             hottier.drain_now()  # tier-down proceeds from survivors
             assert _payload_objects(root)
             assert ".tierdown" in _durable_objects(root)
+
+
+# ----------------------------- degraded ack / delete-drain / stale-drain
+
+
+def test_underreplicated_put_uses_spare_host():
+    """A dead ring host must not silently halve the replication factor:
+    placement continues to spare hosts outside the ring, so the take
+    still acks at k RAM replicas without touching the durable tier."""
+    base = _mem_base("spare")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=4, k=2, drain="manual"):
+        hottier.kill_host(1)  # rank 0's ring is hosts {0, 1}
+        snap = Snapshot.take(root, _state(11))
+        assert not _payload_objects(root)  # ack'd from RAM alone
+        stats = hottier.runtime().stats_snapshot()
+        assert stats["write_through"] == 0
+        assert stats["degraded_puts"] == 0
+        # The k-1-loss invariant holds over the SUBSTITUTED replica
+        # set: losing host 0 leaves the spare (host 2) serving reads.
+        hottier.kill_host(0)
+        target = _target()
+        snap.restore({"s": target["s"]})
+        _assert_restored(target, 11)
+        assert hottier.runtime().stats_snapshot()["fallback_objects"] == 0
+
+
+def test_underreplicated_put_writes_through_before_ack():
+    """When k replicas cannot be placed anywhere (world=2, k=2, one
+    host dead: no spares exist), the write must degrade to a
+    synchronous durable write-through BEFORE the ack — an acked object
+    never rests on a lone RAM copy, so losing the one surviving host
+    afterwards cannot lose committed bytes."""
+    base = _mem_base("degack")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        hottier.kill_host(1)
+        snap = Snapshot.take(root, _state(13))
+        # Durable BEFORE any drain ran: the ack did not rely on RAM.
+        assert _payload_objects(root)
+        stats = hottier.runtime().stats_snapshot()
+        assert stats["degraded_puts"] >= 1
+        assert stats["write_through"] >= 1
+        # Now lose the single surviving replica host too.
+        hottier.kill_host(0)
+        target = _target()
+        snap.restore({"s": target["s"]})
+        _assert_restored(target, 13)
+
+
+def test_inflight_drain_cannot_resurrect_deleted_snapshot(tmp_path):
+    """The delete/drain race, in-flight edition: an item already popped
+    off the drain queue (the drainer holding the object bytes) when
+    ``delete`` runs must not complete its durable write after the
+    sweep — the drain re-checks the forgotten root around the write and
+    skips (or undoes) it."""
+    root = str(tmp_path / "step-0")
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        snap = Snapshot.take(root, _state(4))
+        rt = hottier.runtime()
+        with rt._cond:  # pop as the background drainer would
+            item = rt._queue.popleft()
+        snap.delete()  # cancels drains FIRST, then durable deletes
+        rt._drain_item(*item)  # the "in-flight" drain now completes
+        assert not _payload_objects(root)
+        assert ".tierdown" not in _durable_objects(root)
+        assert rt.stats_snapshot()["drain_lost"] == 0
+        assert not hottier.buffered_roots()
+
+
+def test_delete_waits_for_inflight_drain(tmp_path):
+    """delete must not overtake a drain whose durable write is already
+    in flight: forget_root condition-waits on the in-flight item, so
+    the durable deletes run strictly after the write lands — and sweep
+    it — leaving nothing resurrected."""
+    root = str(tmp_path / "step-0")
+    # nth=2: the take's logical write is match 1 (absorbed into RAM);
+    # the drain's durable write is match 2 and gets the latency.
+    sched = fl.FaultSchedule().latency(
+        op="write", path="0/s/w", seconds=0.6, nth=2
+    )
+    with fl.inject(sched):
+        with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+            snap = Snapshot.take(root, _state(6))
+            rt = hottier.runtime()
+            drainer = threading.Thread(target=rt.drain_now)
+            drainer.start()
+            deadline = time.monotonic() + 5.0
+            while not rt._inflight_items and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert rt._inflight_items  # the slowed write is in flight
+            snap.delete()  # must wait the write out, then remove it
+            drainer.join(timeout=10.0)
+            assert not drainer.is_alive()
+            assert not _payload_objects(root)
+            assert not hottier.buffered_roots()
+
+
+def test_rewrite_while_drain_queued_drains_latest_bytes():
+    """Re-writing an object whose drain is still QUEUED replaces the
+    queued item (same path, new tag): the drain persists the newest
+    bytes, and the durable tier never holds stale data after flush."""
+    base = _mem_base("requeue")
+    root = f"{base}/step-0"
+    old, new = b"A" * 256, b"B" * 256
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", old)
+        rt.enqueue_drain(root, "0/s/w")
+        rt.hot_put(root, "0/s/w", new)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:
+            items = [i for i in rt._queue if i[1] == "0/s/w"]
+        assert len(items) == 1  # superseded item replaced, not doubled
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=5.0)
+    hottier.reset_hot_tier()
+    assert _read_bytes(root, "0/s/w") == new
+
+
+def test_rewrite_while_drain_inflight_is_not_resurrected_stale():
+    """An IN-FLIGHT drain of superseded bytes must neither clear the
+    newer write's pending entry nor mark the newer replicas evictable
+    (they are the only copy of the newest bytes); the newer item then
+    drains the bytes the durable tier must end up with."""
+    base = _mem_base("staledrain")
+    root = f"{base}/step-0"
+    old, new = b"A" * 256, b"B" * 256
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", old)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:  # pop as the background drainer would
+            item = rt._queue.popleft()
+        rt.hot_put(root, "0/s/w", new)
+        rt.enqueue_drain(root, "0/s/w")
+        rt._drain_item(*item)  # the stale in-flight drain completes
+        state = rt.root_state(root)
+        assert state.pending == {"0/s/w"}
+        key = f"{root}/0/s/w"
+        for host in ht_tier.replica_hosts_for(key):
+            assert not ht_tier.get_replica(key, host).drained
+        hottier.drain_now()
+        assert not rt.root_state(root).pending
+    hottier.reset_hot_tier()
+    assert _read_bytes(root, "0/s/w") == new
+
+
+def test_degraded_rewrite_cancels_stale_drain_and_keeps_latest():
+    """A degraded re-write (write-through) of a path whose drain is
+    still queued quiesces the drain pipeline FIRST: the stale item is
+    removed before the durable write, so it can never overwrite the
+    write-through's bytes, and the flush converges on the latest."""
+    import asyncio
+
+    base = _mem_base("degrewrite")
+    root = f"{base}/step-0"
+    old, new = b"A" * 128, b"B" * 128
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        storage = url_to_storage_plugin(root)
+        try:
+            asyncio.run(storage.write(IOReq(path="0/s/w", data=old)))
+            hottier.kill_host(1)  # the re-write cannot reach k replicas
+            asyncio.run(storage.write(IOReq(path="0/s/w", data=new)))
+        finally:
+            storage.close()
+        rt = hottier.runtime()
+        with rt._cond:
+            assert not [i for i in rt._queue if i[1] == "0/s/w"]
+        assert rt.stats_snapshot()["degraded_puts"] == 1
+        # The surviving replica holds the new bytes and is evictable.
+        key = f"{root}/0/s/w"
+        obj = ht_tier.get_replica(key, 0)
+        assert obj.data == new and obj.drained
+        hottier.drain_now()
+    hottier.reset_hot_tier()
+    assert _read_bytes(root, "0/s/w") == new
+
+
+def test_rewrite_drops_stale_replicas_outside_new_placement():
+    """When the replica set changes between writes (spare substitution,
+    then the ring peer comes back), replicas of the superseded bytes on
+    hosts the new placement did not revisit are dropped — they would
+    otherwise serve stale reads and pin RAM undrained forever."""
+    base = _mem_base("stalepin")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=3, k=2, drain="manual"):
+        rt = hottier.runtime()
+        hottier.kill_host(1)
+        placed, _tag = rt.hot_put(root, "0/s/w", b"A" * 64)
+        key = f"{root}/0/s/w"
+        assert placed == 2
+        assert sorted(ht_tier.replica_hosts_for(key)) == [0, 2]
+        hottier.revive_host(1)
+        placed, _tag = rt.hot_put(root, "0/s/w", b"B" * 64)
+        assert placed == 2  # back on the ring: hosts 0 and 1
+        hosts = sorted(ht_tier.replica_hosts_for(key))
+        assert hosts == [0, 1]  # host 2's stale replica dropped
+        for host in hosts:
+            assert ht_tier.get_replica(key, host).data == b"B" * 64
+
+
+def test_write_through_after_commit_still_records_watermark():
+    """A write-through that retires the root's LAST pending object
+    after commit must still get the ``.tierdown`` watermark recorded —
+    no drain item will ever visit the watermark path otherwise."""
+    import asyncio
+
+    base = _mem_base("wtwm")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        storage = url_to_storage_plugin(root)
+        try:
+            asyncio.run(storage.write(IOReq(path="0/s/w", data=b"A" * 64)))
+            # Commit with the object still pending: no watermark item.
+            asyncio.run(
+                storage.write(IOReq(path=".snapshot_metadata", data=b"{}"))
+            )
+            hottier.kill_host(1)
+            asyncio.run(storage.write(IOReq(path="0/s/w", data=b"B" * 64)))
+        finally:
+            storage.close()
+        hottier.drain_now()
+        assert ".tierdown" in _durable_objects(root)
+        assert hottier.wait_drained(timeout_s=5.0)
+    hottier.reset_hot_tier()
+    assert _read_bytes(root, "0/s/w") == b"B" * 64
+
+
+def test_failed_write_through_rearms_drain(monkeypatch):
+    """A degraded write-through whose durable write FAILS must not
+    silently retire the durability obligation: the drain is re-armed
+    for the placed replicas (which stay unevictable — the only copy)
+    and the next drain_now lands the bytes durably."""
+    monkeypatch.setenv("TPUSNAPSHOT_STORAGE_RETRIES", "0")
+    base = _mem_base("wtfail")
+    root = f"{base}/step-0"
+    # The first matched write is the take's degraded write-through (it
+    # fails → the take fails); the drain's first durable re-drive write
+    # fails too, the next succeeds.
+    sched = fl.FaultSchedule().transient(op="write", path="0/s/w", times=2)
+    with fl.inject(sched):
+        with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+            hottier.kill_host(1)  # every payload put degrades
+            with pytest.raises(Exception):
+                Snapshot.take(root, _state(3))
+            rt = hottier.runtime()
+            # Failed write-through: the obligation survives — newest
+            # bytes still pending, the sole replica unevictable.
+            assert rt.root_state(root).pending == {"0/s/w"}
+            key = f"{root}/0/s/w"
+            assert not ht_tier.get_replica(key, 0).drained
+            # The re-armed drain re-drives the bytes to durable.
+            hottier.drain_now()
+            assert hottier.wait_drained(timeout_s=5.0)
+            assert _payload_objects(root)
+            assert ht_tier.get_replica(key, 0).drained
+
+
+def test_recreated_root_after_delete_gets_watermark():
+    """Deleting a snapshot must not latch its root 'forgotten' forever:
+    a snapshot later re-created at the same root — even one whose
+    payload writes all degrade to write-through (so enqueue_drain never
+    runs) — still gets its ``.tierdown`` watermark and keeps it."""
+    base = _mem_base("recreate")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        snap = Snapshot.take(root, _state(1))
+        snap.delete()
+        hottier.kill_host(1)  # the re-take degrades to write-through
+        Snapshot.take(root, _state(2))
+        hottier.drain_now()
+        assert ".tierdown" in _durable_objects(root)
+        target = _target()
+        Snapshot(root).restore({"s": target["s"]})
+        _assert_restored(target, 2)
+
+
+def test_drain_executors_serialize_per_path():
+    """Two drain executors (background loop + a drain_now re-drive)
+    must never drain the same path concurrently: a queued item whose
+    path has an in-flight drain is deferred until it finishes — the
+    tag ordering between their durable writes would otherwise be lost,
+    leaving superseded bytes durable."""
+    base = _mem_base("serial")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:  # executor 1 takes the item mid-write
+            item = rt._pop_runnable_locked()
+            assert item is not None
+            rt._inflight_begin_locked(item[0], item[1])
+        rt.hot_put(root, "0/s/w", b"B" * 64)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:
+            # Executor 2 must NOT get the newer item for the same path.
+            assert rt._pop_runnable_locked() is None
+            rt._inflight_end_locked(item[0], item[1])
+            assert rt._pop_runnable_locked() is not None
+
+
+def test_replica_replacement_mid_drain_is_not_counted_lost():
+    """hot_put replacing a path's replicas between a drain item's pop
+    and its probe (the foreground re-write window, before enqueue_drain
+    updates the bookkeeping) must not be misread as 'every replica
+    lost': the item is re-driven instead, and the root converges to a
+    clean ``.tierdown`` once the re-write's bookkeeping lands."""
+    base = _mem_base("midswap")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:  # the background drainer holds the A item...
+            item = rt._pop_runnable_locked()
+        rt.hot_put(root, "0/s/w", b"B" * 64)  # ...as the re-write lands
+        rt._drain_item(*item)  # probe finds no tag-A replica
+        assert rt.stats_snapshot()["drain_lost"] == 0
+        rt.enqueue_drain(root, "0/s/w")  # re-write's bookkeeping lands
+        rt.on_commit(root)
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=5.0)
+        assert ".tierdown" in _durable_objects(root)
+    hottier.reset_hot_tier()
+    assert _read_bytes(root, "0/s/w") == b"B" * 64
+
+
+def test_genuine_replica_loss_still_detected():
+    """All replicas actually dying pre-drain is still detected once the
+    re-drive budget is spent: the loss is counted and the root can
+    never tier down clean (truthful accounting)."""
+    base = _mem_base("loss")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w")
+        hottier.kill_host(0)
+        hottier.kill_host(1)
+        hottier.drain_now()
+        assert rt.stats_snapshot()["drain_lost"] == 1
+        rt.on_commit(root)
+        hottier.drain_now()
+        assert ".tierdown" not in _durable_objects(root)
+
+
+def test_zero_capacity_forces_pure_write_through():
+    """``capacity_bytes=0`` (TPUSNAPSHOT_HOT_TIER_BYTES=0) must refuse
+    EVERY put — including the first per host — so nothing is ever
+    buffered in RAM the operator sized to zero."""
+    base = _mem_base("cap0")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(
+        rank=0, world=2, k=2, capacity_bytes=0, drain="manual"
+    ):
+        Snapshot.take(root, _state(5))
+        assert ht_tier.total_buffered_bytes() == 0
+        assert _payload_objects(root)  # everything wrote through
+        assert hottier.runtime().stats_snapshot()["write_through"] >= 1
+
+
+def test_disable_hot_tier_uninstalls_even_if_flush_crashes():
+    """A SimulatedCrash striking the flush inside disable_hot_tier must
+    not leak the wrap hook / runtime global: the tier must come down
+    (and be re-enableable) regardless."""
+    base = _mem_base("disablecrash")
+    root = f"{base}/step-0"
+    sched = fl.FaultSchedule().crash_on(op="hottier.drain")
+    with fl.inject(sched):  # inject OUTER: enable/disable stay LIFO
+        hottier.enable_hot_tier(rank=0, world=2, k=2, drain="manual")
+        Snapshot.take(root, _state(1))
+        with pytest.raises(fl.SimulatedCrash):
+            hottier.disable_hot_tier(flush=True)
+        assert hottier.runtime() is None  # uninstalled despite the crash
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        pass  # re-enable works
+
+
+def test_same_tag_degraded_rewrite_requeues_drain():
+    """Re-writing the SAME bytes while degraded must not let the
+    enqueue dedupe drop the drain obligation after begin_write_through
+    canceled the queued item: an obligation with no queued/in-flight
+    owner would never tier down while wait_drained reports clean."""
+    base = _mem_base("sametag")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        placed, tag = rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w", tag)
+        # Degraded re-write of identical bytes: the quiesce cancels the
+        # queued item...
+        rt.begin_write_through(root, "0/s/w")
+        with rt._cond:
+            assert not rt._queue
+        # ...the durable write fails, and abort must RE-ARM the drain
+        # (the same-tag dedupe must not swallow it).
+        rt.abort_write_through(root, "0/s/w", tag, placed)
+        with rt._cond:
+            assert [i for i in rt._queue if i[1] == "0/s/w"]
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=5.0)
+        assert _payload_objects(root)
+
+
+def test_drain_now_waits_for_other_executors_inflight():
+    """drain_now (the force-flush) must not return while another
+    executor still holds the last item in flight — the caller would
+    tear the tier down believing the bytes are durable."""
+    base = _mem_base("flushwait")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w")
+        with rt._cond:  # another executor holds the only item
+            item = rt._pop_runnable_locked()
+            rt._inflight_begin_locked(item[0], item[1])
+        done = []
+        flusher = threading.Thread(
+            target=lambda: (rt.drain_now(), done.append(True))
+        )
+        flusher.start()
+        time.sleep(0.3)
+        assert not done  # still waiting on the in-flight item
+        rt._drain_item(*item)
+        with rt._cond:
+            rt._inflight_end_locked(item[0], item[1])
+        flusher.join(timeout=5.0)
+        assert done
+
+
+def test_wait_drained_sees_inflight_write_through():
+    """wait_drained must not report a clean flush while a degraded
+    write-through is mid-flight on the foreground: it owns no queue
+    item (begin_write_through canceled it), but the pending entry it
+    deliberately leaves alive keeps the flush dirty until
+    note/abort_write_through resolves it."""
+    base = _mem_base("wtwait")
+    root = f"{base}/step-0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        rt = hottier.runtime()
+        placed, tag = rt.hot_put(root, "0/s/w", b"A" * 64)
+        rt.enqueue_drain(root, "0/s/w", tag)
+        rt.begin_write_through(root, "0/s/w")  # write-through "in flight"
+        assert not hottier.wait_drained(timeout_s=0.3)
+        rt.note_write_through(root, "0/s/w", tag, placed)
+        assert hottier.wait_drained(timeout_s=5.0)
+
+
+def test_tierdown_watermark_counts_are_per_root(tmp_path):
+    """Each root's ``.tierdown`` records THAT root's drained-object
+    count (and its process scope), not the process-cumulative stats
+    counter."""
+    root_a = str(tmp_path / "step-0")
+    root_b = str(tmp_path / "step-1")
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        Snapshot.take(root_a, _state(1))
+        Snapshot.take(root_b, _state(2))
+        hottier.drain_now()
+        for root in (root_a, root_b):
+            watermark = _read_json(root, ".tierdown")
+            assert watermark["drained_objects"] == 1
+            assert watermark["scope"] == "process"

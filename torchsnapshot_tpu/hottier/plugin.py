@@ -12,9 +12,11 @@ Routing:
 - **payload objects** (``<rank>/…``, ``replicated/…``, ``chunked/…``)
   write into peer-host RAM, k-replicated, and ACK without touching the
   durable tier; the runtime's drainer persists them in the background
-  and records the ``.tierdown`` watermark (runtime.py). Reads prefer a
-  fingerprint-verified hot replica and fall back per-object to the
-  durable tier, counting the degradation.
+  and records the ``.tierdown`` watermark (runtime.py). A put that
+  cannot reach k replicas (dead or full peers, spare hosts included)
+  writes through to the durable tier synchronously before the ack.
+  Reads prefer a fingerprint-verified hot replica and fall back
+  per-object to the durable tier, counting the degradation.
 - **control plane** (anything dot-prefixed — metadata, completion
   markers, step markers, reports, progress, the ledger, ``.tierdown``
   itself — plus ``refs/`` back-links and ``@base…`` references) writes
@@ -34,6 +36,7 @@ reconciled through :func:`~.runtime.reconcile_hot_tier`'s own
 accounting, never by pretending RAM is storage.
 """
 
+import asyncio
 from typing import Optional
 
 from ..io_types import IOReq, StoragePlugin, io_payload, is_not_found_error
@@ -65,14 +68,32 @@ class TieredPlugin(StoragePlugin):
                 rt.on_commit(self._root)
             return
         payload = bytes(io_payload(io_req))
-        placed = rt.hot_put(self._root, io_req.path, payload)
-        if placed == 0:
-            # Every replica refused (capacity) or died: degrade to a
-            # synchronous durable write — slower, never less durable.
-            await self._inner.write(io_req)
-            rt.note_write_through(len(payload))
+        placed, tag = rt.hot_put(self._root, io_req.path, payload)
+        if placed < rt.k:
+            # The ack-at-k contract cannot be met from RAM (dead or
+            # full peers, spare hosts included): degrade to a
+            # synchronous durable write BEFORE acknowledging — slower,
+            # never less durable. Whatever replicas did land still
+            # serve hot reads and are immediately evictable. The drain
+            # pipeline for this path is quiesced FIRST, so a drain of
+            # superseded bytes cannot land after our durable write; a
+            # FAILED write re-arms the drain for the placed replicas so
+            # the obligation is never silently retired. The quiesce can
+            # block on an in-flight drain's durable write — run it off
+            # the event loop so concurrent scheduler IO keeps flowing.
+            await asyncio.get_running_loop().run_in_executor(
+                None, rt.begin_write_through, self._root, io_req.path
+            )
+            try:
+                await self._inner.write(io_req)
+            except BaseException:
+                rt.abort_write_through(
+                    self._root, io_req.path, tag, placed
+                )
+                raise
+            rt.note_write_through(self._root, io_req.path, tag, placed)
             return
-        rt.enqueue_drain(self._root, io_req.path)
+        rt.enqueue_drain(self._root, io_req.path, tag)
 
     async def read(self, io_req: IOReq) -> None:
         rt = self._runtime
@@ -97,8 +118,12 @@ class TieredPlugin(StoragePlugin):
         if rt.active and is_payload_path(path):
             # Drop replicas AND cancel the pending drain first: a drain
             # racing this delete must not resurrect the object into the
-            # durable tier after we removed it.
-            dropped = rt.forget_object(self._root, path)
+            # durable tier after we removed it. forget_object can block
+            # waiting out an in-flight drain — keep it off the event
+            # loop so gathered deletes keep flowing.
+            dropped = await asyncio.get_running_loop().run_in_executor(
+                None, rt.forget_object, self._root, path
+            )
         try:
             await self._inner.delete(path)
         except Exception as e:

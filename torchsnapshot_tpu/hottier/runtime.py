@@ -6,10 +6,15 @@ The lifecycle the tiered backend implements (ROADMAP item 5):
    k-replicated (``TPUSNAPSHOT_HOT_TIER_K``, default 2), into peer-host
    RAM stores (tier.py). Placement is rendezvous-deterministic: rank
    ``r``'s objects land on hosts ``r, r+1, … r+k-1 (mod world)``, the
-   rank/world identities coming from the coord layer.
-2. **ack** — the write returns once the replicas are placed; the take's
+   rank/world identities coming from the coord layer; a dead or full
+   ring host is substituted by the next spare host around the ring.
+2. **ack** — the write returns once k replicas are placed; the take's
    commit protocol (completion markers, metadata-last) proceeds
-   unchanged, so ``async_take`` acknowledges at RAM speed.
+   unchanged, so ``async_take`` acknowledges at RAM speed. If fewer
+   than k replicas could be placed anywhere (dead or full peers), the
+   write degrades to a synchronous durable write-through BEFORE the
+   ack — an acknowledged object is always either k-replicated in RAM
+   or already durable, never resting on a lone RAM copy.
 3. **tier-down** — a drainer persists each object to the durable plugin
    in the background and, once a committed root is fully drained,
    records a ``.tierdown`` watermark next to the manifest. A replica
@@ -93,15 +98,51 @@ class _RootState:
 
     def __init__(self) -> None:
         self.pending: Set[str] = set()  # payload paths not yet durable
+        # Content tag of the NEWEST bytes written at each pending path —
+        # the tag a drain item must match to retire the path. A drain of
+        # superseded bytes (the object was re-written while its drain
+        # was queued or in flight) is recognized by the mismatch and
+        # neither clears pending nor marks the new replicas evictable.
+        self.tags: Dict[str, str] = {}
         self.committed = False  # .snapshot_metadata observed
         self.tierdown_done = False
         self.drain_lost = 0  # objects whose every replica died pre-drain
+        self.drained_objects = 0  # THIS root's objects tiered down
+        self.write_through = 0  # THIS root's objects written through
         # Items that exhausted their drain attempts: still pending (their
         # hot replicas stay unevictable — the only copy), re-driven by
         # the next drain_now(). wait_drained() reports them truthfully.
         self.stranded: Set[str] = set()
         self.tierdown_attempts = 0
         self.tierdown_stranded = False
+
+
+class _DrainPluginCache:
+    """Size-1 durable-plugin cache for one drain executor: a take's
+    items share a root, so backend-client construction/teardown is paid
+    per ROOT CHANGE instead of per drained object. close() after an
+    item failure (the client may be poisoned) and when the executor
+    exits."""
+
+    def __init__(self, runtime: "HotTierRuntime") -> None:
+        self._runtime = runtime
+        self._root: Optional[str] = None
+        self._plugin: Any = None
+
+    def get(self, root: str) -> Any:
+        if self._plugin is None or self._root != root:
+            self.close()
+            self._plugin = self._runtime._durable_plugin(root)
+            self._root = root
+        return self._plugin
+
+    def close(self) -> None:
+        plugin, self._plugin, self._root = self._plugin, None, None
+        if plugin is not None:
+            try:
+                plugin.close()
+            except Exception as e:
+                logger.warning(f"drain plugin close failed: {e!r}")
 
 
 class HotTierRuntime:
@@ -127,9 +168,23 @@ class HotTierRuntime:
         self.active = True
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        self._queue: Deque[Tuple[str, Optional[str], int]] = deque()
+        # Queue items: (root, path, tag, attempts); a watermark-only
+        # item is (root, None, None, 0).
+        self._queue: Deque[
+            Tuple[str, Optional[str], Optional[str], int]
+        ] = deque()
         self._roots: Dict[str, _RootState] = {}
         self._inflight = 0
+        # In-flight drain items by (root, path): what forget_object /
+        # forget_root condition-wait on, so a delete returns only after
+        # any drain already holding the object bytes has finished (and
+        # its forgotten-root re-check has run).
+        self._inflight_items: Dict[Tuple[str, Optional[str]], int] = {}
+        # Roots dropped by forget_root. An in-flight drain re-checks
+        # this around its durable write: a write that raced a delete is
+        # skipped (pre-check) or undone (post-check) so a deleted
+        # snapshot's objects are never resurrected as durable garbage.
+        self._forgotten: Set[str] = set()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.drain_error: Optional[BaseException] = None
@@ -143,6 +198,7 @@ class HotTierRuntime:
             "fallback_bytes": 0,
             "replicas": 0,
             "write_through": 0,
+            "degraded_puts": 0,
             "drained_objects": 0,
             "drained_bytes": 0,
             "drain_lost": 0,
@@ -152,11 +208,19 @@ class HotTierRuntime:
 
     # ---------------------------------------------------------- placement
 
+    def _placement_ring(self) -> List[int]:
+        """Every host in this rank's deterministic placement order: the
+        preferred ring hosts first, then the spares around the ring —
+        derived from (rank, world) alone, the same information every
+        peer derives from the coord rendezvous."""
+        return [(self.rank + i) % self.world for i in range(self.world)]
+
     def replica_hosts(self) -> List[int]:
-        """This rank's replica set: itself plus the next k-1 hosts in
-        ring order — deterministic from (rank, world, k) alone, the same
-        information every peer derives from the coord rendezvous."""
-        return [(self.rank + i) % self.world for i in range(self.k)]
+        """This rank's PREFERRED replica set: itself plus the next k-1
+        hosts in ring order. hot_put tries these first and continues to
+        the remaining ring hosts (spares) when they cannot give k
+        replicas."""
+        return self._placement_ring()[: self.k]
 
     @staticmethod
     def _key(root: str, path: str) -> str:
@@ -164,16 +228,28 @@ class HotTierRuntime:
 
     # -------------------------------------------------------- write side
 
-    def hot_put(self, root: str, path: str, payload: bytes) -> int:
-        """Replicate one payload object into peer RAM; returns how many
-        replicas were placed (0 = refused everywhere: caller degrades to
-        durable write-through). Each replica placement is a storage-op
-        boundary (``hottier.replicate``) so the crash-point enumerator
-        can strike between replicas."""
+    def hot_put(
+        self, root: str, path: str, payload: bytes
+    ) -> Tuple[int, str]:
+        """Replicate one payload object into peer RAM; returns
+        ``(placed, tag)`` — how many replicas were placed and the
+        payload's content tag (so callers never recompute or re-read
+        it). The ring hosts are tried first; if they cannot give k
+        replicas (dead or full peers), placement continues around the
+        ring to spare hosts outside the replica set, so a single lost
+        peer does not silently halve the replication factor. Fewer than
+        k placed = the ack-at-k contract cannot be met from RAM: the
+        caller must write through to the durable tier before
+        acknowledging (0 placed additionally means no hot copy at all).
+        Each replica placement is a storage-op boundary
+        (``hottier.replicate``) so the crash-point enumerator can strike
+        between replicas."""
         key = self._key(root, path)
         tag = tier.payload_tag(payload)
         placed = 0
-        for host in self.replica_hosts():
+        for i, host in enumerate(self._placement_ring()):
+            if i >= self.k and placed >= self.k:
+                break
             emit_storage_op("hottier.replicate", f"host{host}:{path}")
             try:
                 if tier.put_replica(
@@ -187,23 +263,176 @@ class HotTierRuntime:
             # No replica landed: any stale replicas of an earlier object
             # at this key must not survive a write they no longer match.
             tier.forget_key(key)
+        else:
+            # The replica set may have changed since the last write of
+            # this key (dead ring peer, spare substitution): replicas of
+            # superseded bytes on hosts this placement did not revisit
+            # would serve stale reads and pin RAM undrained forever.
+            tier.drop_stale_replicas(key, tag)
         with self._lock:
             self._stats["replicas"] += placed
-        return placed
+        return placed, tag
 
-    def note_write_through(self, nbytes: int) -> None:
-        with self._lock:
-            self._stats["write_through"] += 1
-        telemetry.counter(_metric_names.HOT_TIER_WRITE_THROUGH).inc()
+    def _cancel_queued_locked(
+        self, root: str, path: Optional[str] = None
+    ) -> None:
+        """``_cond`` held: remove queued drain items of ``root`` — one
+        path, or (path None) every item of the root, watermark
+        sentinels included."""
+        self._queue = deque(
+            item
+            for item in self._queue
+            if not (
+                item[0] == root and (path is None or item[1] == path)
+            )
+        )
 
-    def enqueue_drain(self, root: str, path: str) -> None:
+    def begin_write_through(self, root: str, path: str) -> None:
+        """Quiesce the drain pipeline for ``path`` ahead of a
+        synchronous durable write-through: the queued drain item (if
+        any) is removed and any IN-FLIGHT drain of the path waited out,
+        so a drain still holding superseded bytes can never land its
+        durable write after (and over) the write-through's. The pending
+        entry deliberately SURVIVES until :meth:`note_write_through`
+        (success) or :meth:`abort_write_through` (failure) — a failed
+        write-through must not silently retire the durability
+        obligation. Call BEFORE the durable write."""
         root = root.rstrip("/")
         with self._cond:
+            self._cancel_queued_locked(root, path)
+            self._cond.notify_all()
+            if not self._wait_inflight_locked(
+                lambda: self._inflight_items.get((root, path), 0)
+            ):
+                logger.warning(
+                    f"begin_write_through: in-flight drain of "
+                    f"{root}/{path} did not finish in time; its durable "
+                    f"write may land after the write-through's"
+                )
+
+    def abort_write_through(
+        self, root: str, path: str, tag: Optional[str], placed: int
+    ) -> None:
+        """The synchronous durable write of a degraded put FAILED: the
+        newest bytes exist only in the ``placed`` (< k) replicas hot_put
+        left behind. Re-arm the drain pipeline for them so the
+        obligation stays visible — pending/tags point at the newest tag
+        and a drain item is re-queued; its hot replicas stay unevictable
+        until it lands. With placed == 0 the bytes exist in NO tier and
+        the failed write is propagating to the caller (the take fails):
+        drop any stale pending entry so it cannot block another object's
+        truthful bookkeeping."""
+        root_key = root.rstrip("/")
+        if placed > 0:
+            self.enqueue_drain(root, path, tag)
+            return
+        with self._cond:
+            state = self._roots.get(root_key)
+            if state is not None:
+                state.pending.discard(path)
+                state.tags.pop(path, None)
+                state.stranded.discard(path)
+            self._cond.notify_all()
+
+    def note_write_through(
+        self, root: str, path: str, tag: Optional[str], placed: int
+    ) -> None:
+        """The object was written through to the durable tier
+        synchronously before ack — either no replica landed (placed ==
+        0) or fewer than k did (a DEGRADED put: durability is restored
+        by the synchronous write, at storage speed instead of RAM
+        speed). Retires the path's pending entry (the durable tier now
+        holds the newest bytes) and marks surviving replicas of ``tag``
+        drained, i.e. evictable and still serving hot reads. Call AFTER
+        the durable write SUCCEEDED (and after
+        :meth:`begin_write_through`)."""
+        root = root.rstrip("/")
+        key = self._key(root, path)
+        degraded = 0 < placed < self.k
+        watermark_due = False
+        with self._cond:
+            self._stats["write_through"] += 1
+            if degraded:
+                self._stats["degraded_puts"] += 1
+            self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
-            if path in state.pending:
-                return  # retried write of the same object: already queued
+            state.write_through += 1
+            state.pending.discard(path)
+            state.tags.pop(path, None)
+            state.stranded.discard(path)
+            if (
+                state.committed
+                and not state.pending
+                and not state.tierdown_done
+            ):
+                # This write-through retired the root's last pending
+                # object after commit: no drain item will ever visit the
+                # watermark path, so enqueue the watermark-only sentinel
+                # here (idempotent — _maybe_tierdown checks
+                # tierdown_done).
+                self._queue.append((root, None, None, 0))
+                watermark_due = True
+            self._cond.notify_all()
+        if tag is not None:
+            tier.mark_drained(key, tag)
+        telemetry.counter(_metric_names.HOT_TIER_WRITE_THROUGH).inc()
+        if degraded:
+            telemetry.counter(_metric_names.HOT_TIER_DEGRADED_PUTS).inc()
+            logger.warning(
+                f"hot tier degraded: only {placed}/{self.k} replicas of "
+                f"{key} could be placed; the object was written through "
+                f"to the durable tier before ack"
+            )
+        if watermark_due and self.drain_mode == "background":
+            self._ensure_thread()
+
+    def enqueue_drain(
+        self, root: str, path: str, tag: Optional[str] = None
+    ) -> None:
+        root = root.rstrip("/")
+        if tag is None:
+            tag = tier.key_tag(self._key(root, path))
+        with self._cond:
+            self._forgotten.discard(root)
+            state = self._roots.setdefault(root, _RootState())
+            was_pending = path in state.pending
+            prev = state.tags.get(path) if was_pending else None
+            was_stranded = path in state.stranded
+            state.stranded.discard(path)
             state.pending.add(path)
-            self._queue.append((root, path, 0))
+            if tag is not None:
+                state.tags[path] = tag
+            if was_pending:
+                # Only a previously-pending path can have a queued or
+                # in-flight item — the brand-new-object hot path (the
+                # common case per take) skips the O(queue) scans below.
+                if (
+                    prev is not None
+                    and prev == tag
+                    and not was_stranded
+                    and (
+                        any(
+                            i[0] == root and i[1] == path
+                            for i in self._queue
+                        )
+                        or self._inflight_items.get((root, path), 0) > 0
+                    )
+                ):
+                    # Retried write of the same bytes AND a queued or
+                    # in-flight item actually owns it: nothing to do.
+                    # The ownership check matters — begin_write_through
+                    # cancels the queued item while leaving pending/tags
+                    # intact, so a same-tag re-arm (abort_write_through)
+                    # must re-queue or the obligation would be silently
+                    # dropped.
+                    return
+                # A queued item for this path (if any) names superseded
+                # bytes — replace it so the drain persists what the
+                # replicas actually hold now. An IN-FLIGHT item of the
+                # old bytes is left to finish: its tag mismatch makes it
+                # a no-op.
+                self._cancel_queued_locked(root, path)
+            self._queue.append((root, path, tag, 0))
             self._cond.notify_all()
         if self.drain_mode == "background":
             self._ensure_thread()
@@ -216,10 +445,11 @@ class HotTierRuntime:
         queue item."""
         root = root.rstrip("/")
         with self._cond:
+            self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
             state.committed = True
             if not state.pending and not state.tierdown_done:
-                self._queue.append((root, None, 0))
+                self._queue.append((root, None, None, 0))
                 self._cond.notify_all()
         if self.drain_mode == "background":
             self._ensure_thread()
@@ -292,10 +522,26 @@ class HotTierRuntime:
 
     # -------------------------------------------------- delete/reconcile
 
+    def _wait_inflight_locked(
+        self, count_fn, timeout_s: float = 60.0
+    ) -> bool:
+        """Condition-wait (``_cond`` held) until ``count_fn()`` drops to
+        zero; False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while count_fn() > 0:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self._cond.wait(timeout=min(0.2, remaining))
+        return True
+
     def forget_object(self, root: str, path: str) -> bool:
         """Drop every replica of one object and cancel its pending drain
         (a deleted object must never be resurrected into the durable
-        tier by a later drain). True if the hot tier held it."""
+        tier by a later drain): the queued item is removed and any
+        IN-FLIGHT drain of the object is waited out, so by the time this
+        returns the caller's own durable delete cannot be overtaken by a
+        racing tier-down write. True if the hot tier held it."""
         key = self._key(root, path)
         existed = tier.forget_key(key)
         root = root.rstrip("/")
@@ -303,18 +549,29 @@ class HotTierRuntime:
             state = self._roots.get(root)
             if state is not None and path in state.pending:
                 state.pending.discard(path)
-                self._queue = deque(
-                    item
-                    for item in self._queue
-                    if not (item[0] == root and item[1] == path)
-                )
+                state.tags.pop(path, None)
+                state.stranded.discard(path)
+                self._cancel_queued_locked(root, path)
                 existed = True
                 self._cond.notify_all()
+            if not self._wait_inflight_locked(
+                lambda: self._inflight_items.get((root, path), 0)
+            ):
+                logger.warning(
+                    f"forget_object: in-flight drain of {root}/{path} "
+                    f"did not finish in time; its durable write may "
+                    f"land after the delete"
+                )
         return existed
 
     def forget_root(self, root: str) -> int:
         """Drop every buffered object of ``root`` and cancel its drains
-        (``Snapshot.delete`` / prune). Returns objects dropped."""
+        (``Snapshot.delete`` / prune). Queued items are removed, the
+        root is latched forgotten (an in-flight drain re-checks the
+        latch around its durable write and skips or undoes a write that
+        raced the delete), and in-flight items are waited out so the
+        caller's durable deletes run strictly after any tier-down write
+        already holding the object bytes. Returns objects dropped."""
         root = root.rstrip("/")
         dropped = 0
         for key in tier.keys_for_root(root):
@@ -322,10 +579,27 @@ class HotTierRuntime:
                 dropped += 1
         with self._cond:
             self._roots.pop(root, None)
-            self._queue = deque(
-                item for item in self._queue if item[0] != root
-            )
+            self._forgotten.add(root)
+            self._cancel_queued_locked(root)
             self._cond.notify_all()
+            if self._wait_inflight_locked(
+                lambda: sum(
+                    c
+                    for (r, _p), c in self._inflight_items.items()
+                    if r == root
+                )
+            ):
+                # Nothing of this root remains queued or in flight:
+                # drop the latch so it neither leaks (one entry per
+                # pruned step, forever) nor sabotages a snapshot later
+                # re-created at the same root.
+                self._forgotten.discard(root)
+            else:
+                logger.warning(
+                    f"forget_root: in-flight drain of {root} did not "
+                    f"finish in time; its durable write is undone by "
+                    f"the drain's own forgotten-root re-check"
+                )
         return dropped
 
     def object_age_s(self, root: str, path: str) -> Optional[float]:
@@ -352,31 +626,78 @@ class HotTierRuntime:
             )
             self._thread.start()
 
+    def _inflight_begin_locked(
+        self, root: str, path: Optional[str]
+    ) -> None:
+        self._inflight += 1
+        item = (root, path)
+        self._inflight_items[item] = self._inflight_items.get(item, 0) + 1
+
+    def _inflight_end_locked(self, root: str, path: Optional[str]) -> None:
+        self._inflight -= 1
+        item = (root, path)
+        n = self._inflight_items.get(item, 0) - 1
+        if n <= 0:
+            self._inflight_items.pop(item, None)
+        else:
+            self._inflight_items[item] = n
+        self._cond.notify_all()
+
+    def _pop_runnable_locked(
+        self,
+    ) -> Optional[Tuple[str, Optional[str], Optional[str], int]]:
+        """``_cond`` held: pop the next item whose path has NO in-flight
+        drain (None if the queue is empty or everything is deferred).
+        Two executors (the background drainer plus a drain_now
+        re-drive) must never drain the same path concurrently — the tag
+        ordering between their durable writes would be lost, and a
+        stale write landing last would leave superseded bytes durable."""
+        for _ in range(len(self._queue)):
+            item = self._queue.popleft()
+            if (
+                item[1] is not None
+                and self._inflight_items.get((item[0], item[1]), 0)
+            ):
+                self._queue.append(item)  # deferred behind the in-flight
+                continue
+            return item
+        return None
+
     def _drain_loop(self) -> None:
-        while True:
-            with self._cond:
-                while not self._queue and not self._stop:
-                    self._cond.wait(timeout=0.2)
-                if self._stop and not self._queue:
-                    return
-                root, path, attempts = self._queue.popleft()
-                self._inflight += 1
-            try:
-                self._drain_item(root, path, attempts)
-            except Exception as e:
-                # Per-item failures (e.g. a transient .tierdown write
-                # error) must not kill the drainer — the item's own
-                # requeue/leave-pending handling already ran; later
-                # items (or drain_now) re-drive what's left.
-                logger.warning(f"hot-tier drain item failed: {e!r}")
-            except BaseException as e:  # a crashed drainer stays crashed
-                self.drain_error = e
-                logger.warning(f"hot-tier drain died: {e!r}")
-                return  # inflight released by the finally below
-            finally:
+        cache = _DrainPluginCache(self)
+        try:
+            while True:
                 with self._cond:
-                    self._inflight -= 1
-                    self._cond.notify_all()
+                    while True:
+                        item = self._pop_runnable_locked()
+                        if item is not None:
+                            break
+                        if self._stop and not self._queue:
+                            return
+                        self._cond.wait(timeout=0.2)
+                    root, path, tag, attempts = item
+                    self._inflight_begin_locked(root, path)
+                try:
+                    self._drain_item(
+                        root, path, tag, attempts, plugin=cache.get(root)
+                    )
+                except Exception as e:
+                    # Per-item failures (e.g. a transient .tierdown
+                    # write error) must not kill the drainer — the
+                    # item's own requeue/leave-pending handling already
+                    # ran; later items (or drain_now) re-drive what's
+                    # left. The cached client may be poisoned: drop it.
+                    cache.close()
+                    logger.warning(f"hot-tier drain item failed: {e!r}")
+                except BaseException as e:  # crashed drainer stays crashed
+                    self.drain_error = e
+                    logger.warning(f"hot-tier drain died: {e!r}")
+                    return  # inflight released by the finally below
+                finally:
+                    with self._cond:
+                        self._inflight_end_locked(root, path)
+        finally:
+            cache.close()
 
     def _requeue_stranded(self) -> None:
         """Move every stranded object/watermark back into the queue with
@@ -386,12 +707,14 @@ class HotTierRuntime:
         with self._cond:
             for root, state in self._roots.items():
                 for path in sorted(state.stranded):
-                    self._queue.append((root, path, 0))
+                    self._queue.append(
+                        (root, path, state.tags.get(path), 0)
+                    )
                 state.stranded.clear()
                 if state.tierdown_stranded:
                     state.tierdown_stranded = False
                     state.tierdown_attempts = 0
-                    self._queue.append((root, None, 0))
+                    self._queue.append((root, None, None, 0))
             self._cond.notify_all()
 
     def drain_now(self) -> None:
@@ -401,18 +724,37 @@ class HotTierRuntime:
         so faultline's op stream stays deterministic; a SimulatedCrash
         propagates to the caller like any crash."""
         self._requeue_stranded()
-        while True:
-            with self._cond:
-                if not self._queue:
-                    return
-                root, path, attempts = self._queue.popleft()
-                self._inflight += 1
-            try:
-                self._drain_item(root, path, attempts)
-            finally:
+        cache = _DrainPluginCache(self)
+        try:
+            while True:
                 with self._cond:
-                    self._inflight -= 1
-                    self._cond.notify_all()
+                    if not self._queue:
+                        # Force-flush contract: another executor (the
+                        # background drainer) may still hold an item in
+                        # flight — wait it out (it may also requeue on
+                        # failure) before reporting flushed.
+                        while self._inflight and not self._queue:
+                            self._cond.wait(timeout=0.2)
+                        if not self._queue:
+                            return
+                    item = self._pop_runnable_locked()
+                    if item is None:
+                        # Everything queued is deferred behind an
+                        # in-flight drain of the same path (another
+                        # executor): wait for it to finish, then re-try.
+                        self._cond.wait(timeout=0.2)
+                        continue
+                    root, path, tag, attempts = item
+                    self._inflight_begin_locked(root, path)
+                try:
+                    self._drain_item(
+                        root, path, tag, attempts, plugin=cache.get(root)
+                    )
+                finally:
+                    with self._cond:
+                        self._inflight_end_locked(root, path)
+        finally:
+            cache.close()
 
     def _durable_plugin(self, root: str):
         from ..storage_plugin import url_to_storage_plugin
@@ -424,45 +766,106 @@ class HotTierRuntime:
             _BYPASS.active = False
 
     def _drain_item(
-        self, root: str, path: Optional[str], attempts: int
+        self,
+        root: str,
+        path: Optional[str],
+        tag: Optional[str],
+        attempts: int,
+        plugin: Any = None,
     ) -> None:
-        plugin = self._durable_plugin(root)
+        owned = plugin is None
+        if owned:
+            plugin = self._durable_plugin(root)
         try:
             if path is not None:
-                self._drain_object(plugin, root, path, attempts)
+                self._drain_object(plugin, root, path, tag, attempts)
             self._maybe_tierdown(plugin, root)
         finally:
-            plugin.close()
+            if owned:
+                plugin.close()
+
+    def _item_current_locked(
+        self, root: str, path: str, tag: Optional[str]
+    ) -> bool:
+        """``_cond`` held: does (root, path, tag) still name work to do?
+        False when the root was forgotten (delete), the path's drain was
+        canceled, or the object was re-written since this item was
+        queued (a newer item owns the path; draining OUR bytes would
+        persist stale data)."""
+        if root in self._forgotten:
+            return False
+        state = self._roots.get(root)
+        if state is None or path not in state.pending:
+            return False
+        expected = state.tags.get(path)
+        return tag is None or expected is None or expected == tag
 
     def _drain_object(
-        self, plugin: Any, root: str, path: str, attempts: int
+        self,
+        plugin: Any,
+        root: str,
+        path: str,
+        tag: Optional[str],
+        attempts: int,
     ) -> None:
         key = self._key(root, path)
+        with self._cond:
+            if not self._item_current_locked(root, path, tag):
+                return  # canceled or superseded while queued
         data: Optional[bytes] = None
+        data_tag: Optional[str] = tag
         for host in tier.replica_hosts_for(key) or []:
             try:
                 obj = tier.get_replica(key, host)
             except (tier.HostLostError, KeyError):
                 continue
+            if tag is not None and obj.tag != tag:
+                continue  # replica of a different write of this object
             if tier.payload_tag(obj.data) == obj.tag:
                 data = obj.data
+                data_tag = obj.tag
                 break
         if data is None:
-            # Every replica died before tier-down: the bytes are gone.
-            # The loss is counted and the pending entry retired — the
-            # root can never tier down clean, and a restore of this
-            # object will fail loudly at the durable tier (detect, not
-            # silent corruption).
-            logger.warning(
-                f"hot-tier drain: every replica of {key} lost before "
-                f"tier-down; the object was never persisted"
-            )
+            requeued = False
             with self._cond:
-                self._stats["drain_lost"] += 1
-                state = self._roots.get(root)
-                if state is not None:
-                    state.pending.discard(path)
-                    state.drain_lost += 1
+                if not self._item_current_locked(root, path, tag):
+                    return  # superseded mid-probe: not a loss
+                if attempts + 1 < _DRAIN_MAX_ATTEMPTS:
+                    # No matching replica RIGHT NOW — but a foreground
+                    # re-write may be mid-flight between replacing the
+                    # replicas (hot_put) and updating the drain
+                    # bookkeeping (enqueue_drain / write-through), which
+                    # would make this item stale, not the bytes lost.
+                    # Re-drive instead of declaring loss; a real loss is
+                    # declared once the budget is spent with the
+                    # bookkeeping still naming this item.
+                    self._queue.append((root, path, tag, attempts + 1))
+                    self._cond.notify_all()
+                    requeued = True
+                else:
+                    # Every replica died before tier-down: the bytes
+                    # are gone. The loss is counted and the pending
+                    # entry retired — the root can never tier down
+                    # clean, and a restore of this object will fail
+                    # loudly at the durable tier (detect, not silent
+                    # corruption).
+                    self._stats["drain_lost"] += 1
+                    state = self._roots.get(root)
+                    if state is not None:
+                        state.pending.discard(path)
+                        state.tags.pop(path, None)
+                        state.drain_lost += 1
+            if requeued:
+                # Give a mid-flight foreground re-write time to land
+                # its bookkeeping before the re-probe — back-to-back
+                # re-pops would burn the whole budget in microseconds
+                # and declare a phantom loss.
+                time.sleep(0.01 * (attempts + 1))
+            else:
+                logger.warning(
+                    f"hot-tier drain: every replica of {key} lost before "
+                    f"tier-down; the object was never persisted"
+                )
             return
         emit_storage_op("hottier.drain", path)
         try:
@@ -470,7 +873,7 @@ class HotTierRuntime:
         except Exception as e:
             if attempts + 1 < _DRAIN_MAX_ATTEMPTS:
                 with self._cond:
-                    self._queue.append((root, path, attempts + 1))
+                    self._queue.append((root, path, tag, attempts + 1))
                     self._cond.notify_all()
                 logger.warning(
                     f"hot-tier drain of {key} failed "
@@ -493,16 +896,53 @@ class HotTierRuntime:
                 f"drain_now; no .tierdown until it lands)"
             )
             return
-        tier.mark_drained(key)
+        # Only replicas of the bytes actually written become evictable:
+        # a re-write racing this drain keeps ITS replicas pinned until
+        # its own item lands.
+        tier.mark_drained(key, data_tag)
         with self._cond:
-            self._stats["drained_objects"] += 1
-            self._stats["drained_bytes"] += len(data)
+            forgotten = root in self._forgotten
             state = self._roots.get(root)
-            if state is not None:
+            # Retire the pending entry only if the ITEM tag is still the
+            # path's expected tag (strict: a popped/changed entry means
+            # the write raced a delete or supersession and a newer item
+            # — deferred behind us by _pop_runnable_locked — owns it).
+            current = state is not None and state.tags.get(path) == tag
+            if current and not forgotten:
+                # An undone (deleted-root) or superseded (re-converged
+                # and counted by its own item) write must not inflate
+                # the tier-down throughput accounting.
+                self._stats["drained_objects"] += 1
+                self._stats["drained_bytes"] += len(data)
+            if current:
                 state.pending.discard(path)
-        telemetry.counter(_metric_names.HOT_TIER_DRAINED_BYTES).inc(
-            len(data)
-        )
+                state.tags.pop(path, None)
+                state.drained_objects += 1
+        if current and not forgotten:
+            telemetry.counter(_metric_names.HOT_TIER_DRAINED_BYTES).inc(
+                len(data)
+            )
+        if forgotten:
+            # The snapshot was deleted while our durable write was in
+            # flight: the object must not outlive it as durable garbage.
+            try:
+                asyncio.run(plugin.delete(path))
+            except Exception as e:
+                logger.warning(
+                    f"hot-tier drain: undo of {key} after delete "
+                    f"failed: {e!r}"
+                )
+        elif not current:
+            # Our write raced a supersession whose bookkeeping already
+            # retired the path (e.g. a write-through that outlasted
+            # begin_write_through's bounded wait): the durable tier may
+            # now hold OUR superseded bytes on top of the newer write's.
+            # Re-converge on the newest replicas (idempotent — if the
+            # newer item is simply deferred behind us, enqueue_drain
+            # dedupes against it).
+            newest = tier.key_tag(key)
+            if newest is not None and newest != tag:
+                self.enqueue_drain(root, path, newest)
 
     def _maybe_tierdown(self, plugin: Any, root: str) -> None:
         with self._cond:
@@ -516,10 +956,19 @@ class HotTierRuntime:
             )
             if not ready:
                 return
+            drained_objects = state.drained_objects
+            write_through = state.write_through
         emit_storage_op("hottier.tierdown", TIERDOWN_FNAME)
+        # Counts are THIS root's and THIS process's: in a multi-rank job
+        # every metadata-writing process records its own drain progress;
+        # the watermark does not (yet) assert other ranks' objects
+        # drained — cross-rank drain coordination is future work, and
+        # the explicit scope field keeps operators/sweeps honest.
         doc = {
             "format_version": 1,
-            "drained_objects": self._stats["drained_objects"],
+            "drained_objects": drained_objects,
+            "write_through_objects": write_through,
+            "scope": "process",
             "ts_epoch_s": round(time.time(), 3),
         }
         try:
@@ -541,7 +990,7 @@ class HotTierRuntime:
                 if state is not None:
                     state.tierdown_attempts += 1
                     if state.tierdown_attempts < _DRAIN_MAX_ATTEMPTS:
-                        self._queue.append((root, None, 0))
+                        self._queue.append((root, None, None, 0))
                     else:
                         state.tierdown_stranded = True
                 self._cond.notify_all()
@@ -551,21 +1000,49 @@ class HotTierRuntime:
             )
             return
         with self._cond:
+            forgotten = root in self._forgotten
             state = self._roots.get(root)
             if state is not None:
                 state.tierdown_done = True
             self._cond.notify_all()
+        if forgotten:
+            # Deleted mid-watermark-write: take the marker back out.
+            try:
+                asyncio.run(plugin.delete(TIERDOWN_FNAME))
+            except Exception as e:
+                logger.warning(
+                    f"hot-tier drain: undo of {root}/{TIERDOWN_FNAME} "
+                    f"after delete failed: {e!r}"
+                )
+
+    def _dirty_pending_locked(self) -> bool:
+        """``_cond`` held: is any pending path NOT accounted for by
+        stranded? Such a path is owned by a queued/in-flight item or a
+        foreground degraded write-through (queue-invisible between
+        begin_write_through and note/abort) — work that is still
+        resolving and must keep wait_drained waiting. Stranded paths
+        are terminal until a drain_now() re-drive, so they exit the
+        wait and fail the final cleanliness check instead."""
+        return any(
+            s.pending - s.stranded for s in self._roots.values()
+        )
 
     def wait_drained(self, timeout_s: float = 120.0) -> bool:
-        """Block until the drain queue is empty and nothing is in
-        flight; True only on a genuinely clean flush — False on timeout,
+        """Block until the drain queue is empty, nothing is in flight,
+        and no non-stranded pending work remains (including a degraded
+        write-through mid-flight on the foreground, which owns no queue
+        item); True only on a genuinely clean flush — False on timeout,
         a dead drainer, or STRANDED work (objects/watermarks that
         exhausted their attempts and await a drain_now() re-drive):
         claiming success while committed bytes are still hot-tier-only
         would let a caller tear the tier down over the only copy."""
         deadline = time.monotonic() + timeout_s
         with self._cond:
-            while self._queue or self._inflight:
+            while (
+                self._queue
+                or self._inflight
+                or self._dirty_pending_locked()
+            ):
                 if self.drain_error is not None:
                     return False
                 remaining = deadline - time.monotonic()
@@ -682,22 +1159,28 @@ def disable_hot_tier(flush: bool = True, timeout_s: float = 120.0) -> None:
         rt = _RUNTIME
         if rt is None:
             return
-        if flush:
-            if rt.drain_mode == "manual":
-                rt.drain_now()
-            else:
-                rt._ensure_thread()
-                if not rt.wait_drained(timeout_s=timeout_s):
-                    logger.warning(
-                        "disable_hot_tier: drain did not flush within "
-                        f"{timeout_s:g}s; undrained objects remain "
-                        f"hot-tier-only"
-                    )
-        rt.stop()
-        rt.active = False
-        _sp.set_plugin_wrap_hook(_PREV_HOOK)
-        _PREV_HOOK = None
-        _RUNTIME = None
+        try:
+            if flush:
+                if rt.drain_mode == "manual":
+                    rt.drain_now()
+                else:
+                    rt._ensure_thread()
+                    if not rt.wait_drained(timeout_s=timeout_s):
+                        logger.warning(
+                            "disable_hot_tier: drain did not flush "
+                            f"within {timeout_s:g}s; undrained objects "
+                            f"remain hot-tier-only"
+                        )
+        finally:
+            # Uninstall UNCONDITIONALLY — a flush that raises (e.g. a
+            # faultline SimulatedCrash striking a drain op) must not
+            # leak the wrap hook and the runtime global, or the tier
+            # could never be disabled or re-enabled again.
+            rt.stop()
+            rt.active = False
+            _sp.set_plugin_wrap_hook(_PREV_HOOK)
+            _PREV_HOOK = None
+            _RUNTIME = None
 
 
 @contextmanager
@@ -736,6 +1219,7 @@ def reset_pending() -> None:
     with rt._cond:
         rt._queue.clear()
         rt._roots.clear()
+        rt._forgotten.clear()
         rt.drain_error = None
         rt._cond.notify_all()
 

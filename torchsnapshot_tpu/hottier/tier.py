@@ -91,7 +91,10 @@ def host_store(host_id: int, capacity_bytes: Optional[int] = None) -> HostRamSto
     with _TIER_LOCK:
         store = _HOSTS.get(host_id)
         if store is None:
-            store = HostRamStore(host_id, capacity_bytes or (1 << 30))
+            store = HostRamStore(
+                host_id,
+                capacity_bytes if capacity_bytes is not None else (1 << 30),
+            )
             _HOSTS[host_id] = store
         elif capacity_bytes is not None:
             store.capacity_bytes = capacity_bytes
@@ -249,15 +252,47 @@ def forget_key(key: str) -> bool:
         return existed
 
 
-def mark_drained(key: str) -> None:
-    """Flag every replica of ``key`` as persisted (hence evictable)."""
+def mark_drained(key: str, tag: Optional[str] = None) -> None:
+    """Flag replicas of ``key`` as persisted (hence evictable). With
+    ``tag``, only replicas holding exactly those bytes are flagged — a
+    replica of a NEWER re-write of the object is not durable just
+    because an older version of it reached storage."""
     with _TIER_LOCK:
         for h in _KEY_HOSTS.get(key, []):
             store = _HOSTS.get(h)
             if store is not None:
                 obj = store.objects.get(key)
-                if obj is not None:
+                if obj is not None and (tag is None or obj.tag == tag):
                     obj.drained = True
+
+
+def drop_stale_replicas(key: str, tag: str) -> None:
+    """Drop replicas of ``key`` whose content tag differs from ``tag``
+    — superseded bytes left on hosts outside the newest placement when
+    the replica set changed between writes. They must not linger: a
+    self-consistent stale replica would serve old bytes to readers,
+    and being undrained it would pin host RAM forever."""
+    with _TIER_LOCK:
+        for h in list(_KEY_HOSTS.get(key, [])):
+            store = _HOSTS.get(h)
+            obj = store.objects.get(key) if store is not None else None
+            if obj is not None and obj.tag != tag:
+                del store.objects[key]
+                store.used_bytes -= len(obj.data)
+                _index_remove(key, h)
+        _update_buffered_gauge()
+
+
+def key_tag(key: str) -> Optional[str]:
+    """The content tag of ``key``'s current replicas (None when no
+    replica survives)."""
+    with _TIER_LOCK:
+        for h in _KEY_HOSTS.get(key, []):
+            store = _HOSTS.get(h)
+            obj = store.objects.get(key) if store is not None else None
+            if obj is not None:
+                return obj.tag
+        return None
 
 
 def key_age_s(key: str) -> Optional[float]:

@@ -1033,6 +1033,18 @@ class Snapshot:
                         )
                     )
 
+            # Hot-tier replicas of this snapshot go FIRST — before any
+            # durable delete: queued tier-down drains are CANCELED and
+            # in-flight ones waited out (the drain itself re-checks the
+            # forgotten root around its durable write), so a background
+            # drain can never resurrect a deleted snapshot's objects
+            # into the durable tier after the deletes/sweep below run.
+            try:
+                from . import hottier as _hottier
+
+                _hottier.forget_root(self.path)
+            except Exception as e:
+                logger.warning(f"hot-tier buffer GC failed: {e!r}")
             asyncio.run(_delete_all())
             # This snapshot referenced base snapshots: clear OUR
             # back-link markers from their roots so they become
@@ -1044,16 +1056,6 @@ class Snapshot:
                     asyncio.run(_gc_backlinks_in_bases(metadata, self.path))
                 except Exception as e:
                     logger.warning(f"back-link marker GC failed: {e!r}")
-            # Hot-tier replicas of this snapshot go with it — including
-            # any still-pending tier-down, which is CANCELED so a
-            # background drain can never resurrect a deleted snapshot's
-            # objects into the durable tier after the sweep.
-            try:
-                from . import hottier as _hottier
-
-                _hottier.forget_root(self.path)
-            except Exception as e:
-                logger.warning(f"hot-tier buffer GC failed: {e!r}")
         finally:
             storage.close()
 

@@ -353,4 +353,9 @@ HOT_TIER_EVICTIONS = "tpusnapshot_hot_tier_evictions_total"  # counter
 HOT_TIER_WRITE_THROUGH = (
     "tpusnapshot_hot_tier_write_through_total"  # counter
 )
+HOT_TIER_DEGRADED_PUTS = (
+    # Puts that placed >= 1 but < k replicas and had to write through
+    # to the durable tier before acknowledging.
+    "tpusnapshot_hot_tier_degraded_puts_total"  # counter
+)
 HOT_TIER_BUFFERED_BYTES = "tpusnapshot_hot_tier_buffered_bytes"  # gauge
