@@ -650,6 +650,11 @@ def run_hot_tier_block(
             try:
                 Snapshot.take(root, {"model": model})
                 drained = hottier.wait_drained(timeout_s=600.0)
+                # The measured ack->.tierdown window for this take —
+                # regression-gated by bench_compare/timeline alongside
+                # the ratio (a lag blow-up is a drain-bandwidth
+                # regression even when the restore ratio holds).
+                durability_lag_s = hottier.durability_lag_s(root)
                 hot_s, hot_exact = _timed_restore()
                 stats = hottier.runtime().stats_snapshot()
             finally:
@@ -671,6 +676,11 @@ def run_hot_tier_block(
             "durable_restore_s": round(durable_s, 3),
             "hot_vs_durable": round(ratio, 2),
             "meets_5x": bool(ratio >= 5.0),
+            "durability_lag_s": (
+                round(durability_lag_s, 3)
+                if durability_lag_s is not None
+                else None
+            ),
             "modeled_durable_gbps": modeled_durable_gbps,
             "hot_objects": stats["hot_objects"],
             "fallback_objects": stats["fallback_objects"],

@@ -13,12 +13,19 @@ by more than ``--threshold`` (default 20%). Improvements and metrics
 missing from either side never fail the gate — a cut-short run reports
 nulls, and nulls are "not measured", not "zero".
 
-Compared metrics (higher is better):
+Compared metrics:
 
-- ``value`` (snapshot take GB/s)
-- ``restore_GBps``
+- ``value`` (snapshot take GB/s), higher is better
+- ``restore_GBps``, higher is better
 - ``take_vs_ceiling`` / ``restore_vs_ceiling`` (ceiling-relative
-  ratios, robust to the two runs landing on different hardware)
+  ratios, robust to the two runs landing on different hardware),
+  higher is better
+- ``hot_tier.hot_vs_durable`` (the hot-vs-durable restore ratio the
+  hot tier certifies), higher is better
+- ``hot_tier.durability_lag_s`` (the bench take's measured
+  ack→``.tierdown`` window), LOWER is better
+- ``every_step.hot.overhead_pct`` (every-step checkpointing overhead
+  with the tier on, from the goodput accountant), LOWER is better
 
 Uncertified numbers (``restore_uncertified``/``degraded``) are compared
 but flagged in the output — a gate wired to flaky numbers should see
@@ -33,17 +40,27 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-_METRICS: List[Tuple[str, str]] = [
-    ("value", "take GB/s"),
-    ("restore_GBps", "restore GB/s"),
-    ("take_vs_ceiling", "take/ceiling"),
-    ("restore_vs_ceiling", "restore/ceiling"),
+# (dotted key, label, direction): "high" = higher is better (regress on
+# a drop past the threshold), "low" = lower is better (regress on a
+# rise). Dotted keys index into the nested section dicts.
+_METRICS: List[Tuple[str, str, str]] = [
+    ("value", "take GB/s", "high"),
+    ("restore_GBps", "restore GB/s", "high"),
+    ("take_vs_ceiling", "take/ceiling", "high"),
+    ("restore_vs_ceiling", "restore/ceiling", "high"),
+    ("hot_tier.hot_vs_durable", "hot/durable ratio", "high"),
+    ("hot_tier.durability_lag_s", "durability lag s", "low"),
+    ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
 ]
 
 
 def _num(doc: Dict[str, Any], key: str) -> Optional[float]:
-    v = doc.get(key)
-    return float(v) if isinstance(v, (int, float)) else None
+    cur: Any = doc
+    for part in key.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return float(cur) if isinstance(cur, (int, float)) else None
 
 
 def unwrap(doc: Dict[str, Any]) -> Dict[str, Any]:
@@ -70,7 +87,10 @@ def unwrap(doc: Dict[str, Any]) -> Dict[str, Any]:
     import re
 
     out: Dict[str, Any] = {}
-    wanted = {k for k, _ in _METRICS} | {
+    # Nested (dotted) section keys cannot be scavenged from a truncated
+    # tail reliably (their flat names collide across sections): they
+    # simply read as not-measured, which the gate skips.
+    wanted = {k for k, _, _ in _METRICS if "." not in k} | {
         "degraded",
         "restore_uncertified",
     }
@@ -103,7 +123,7 @@ def compare(
     the gate fails."""
     lines: List[str] = []
     regressions: List[str] = []
-    for key, label in _METRICS:
+    for key, label, direction in _METRICS:
         a, b = _num(old, key), _num(new, key)
         if a is None or b is None:
             lines.append(
@@ -119,12 +139,24 @@ def compare(
             )
             continue
         change = (b - a) / a
+        # "high" metrics regress by dropping; "low" metrics (latency,
+        # overhead) regress by rising. Same threshold either way.
+        regressed = (
+            change < -threshold
+            if direction == "high"
+            else change > threshold
+        )
         verdict = "ok"
-        if change < -threshold:
+        if regressed:
             verdict = "REGRESSION"
+            allowed = (
+                f"-{100 * threshold:.0f}%"
+                if direction == "high"
+                else f"+{100 * threshold:.0f}%"
+            )
             regressions.append(
                 f"{label}: {a:g} -> {b:g} ({100 * change:+.1f}% vs "
-                f"-{100 * threshold:.0f}% allowed)"
+                f"{allowed} allowed)"
             )
         lines.append(
             f"{label:18s} old={a:<10g} new={b:<10g} "
@@ -192,6 +224,30 @@ def _self_test() -> int:
     )
     assert not reg, "gaps are missing data, never a regression"
     assert any("step_stall" in line for line in lines), lines
+    # Hot-tier keys: nested (dotted) lookup, and the lower-is-better
+    # direction — a lag/overhead RISE is the regression.
+    hot = dict(
+        base,
+        hot_tier={"hot_vs_durable": 8.0, "durability_lag_s": 1.0},
+        every_step={"hot": {"overhead_pct": 2.0}},
+    )
+    _, reg = compare(hot, dict(hot), 0.2)
+    assert not reg, f"identical hot-tier runs must pass: {reg}"
+    worse_ratio = dict(
+        hot, hot_tier={"hot_vs_durable": 4.0, "durability_lag_s": 1.0}
+    )
+    _, reg = compare(hot, worse_ratio, 0.2)
+    assert reg and "hot/durable" in reg[0], f"ratio halving must fail: {reg}"
+    worse_lag = dict(
+        hot, hot_tier={"hot_vs_durable": 8.0, "durability_lag_s": 3.0}
+    )
+    _, reg = compare(hot, worse_lag, 0.2)
+    assert reg and "durability lag" in reg[0], f"lag 3x must fail: {reg}"
+    worse_ovh = dict(hot, every_step={"hot": {"overhead_pct": 4.5}})
+    _, reg = compare(hot, worse_ovh, 0.2)
+    assert reg and "every-step" in reg[0], f"overhead rise must fail: {reg}"
+    _, reg = compare(base, hot, 0.2)
+    assert not reg, f"hot-tier keys absent on one side are skipped: {reg}"
     print("bench_compare self-test OK")
     return 0
 

@@ -218,6 +218,27 @@ class FaultSchedule:
         )
         return self
 
+    def slow_drain(
+        self,
+        seconds: float = 0.2,
+        path: str = "*",
+        nth: int = 1,
+        times: Optional[int] = None,
+    ) -> "FaultSchedule":
+        """Latency targeting the hot tier's ``hottier.drain`` op
+        boundaries: every matched tier-down write pays ``seconds``
+        before it proceeds — the deterministic way to stretch the
+        ack→``.tierdown`` exposure window past a durability-lag budget
+        and prove the ``durability-lag-above-budget`` doctor rule and
+        the SLO engine's nonzero exit actually fire (docs/FAULTS.md)."""
+        return self.latency(
+            op="hottier.drain",
+            path=path,
+            seconds=seconds,
+            nth=nth,
+            times=times,
+        )
+
     def crash_at(self, op_index: int) -> "FaultSchedule":
         """Crash at global op index ``op_index`` (1-based) and every
         boundary after it — the crash-point enumerator's lever."""

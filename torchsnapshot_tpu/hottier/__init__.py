@@ -34,9 +34,11 @@ from .runtime import (
     HotTierRuntime,
     disable_hot_tier,
     drain_now,
+    durability_lag_s,
     enable_hot_tier,
     forget_root,
     hot_tier,
+    introspect,
     is_enabled,
     is_payload_path,
     reconcile_hot_tier,
@@ -66,9 +68,11 @@ __all__ = [
     "buffered_roots",
     "disable_hot_tier",
     "drain_now",
+    "durability_lag_s",
     "enable_hot_tier",
     "forget_root",
     "hot_tier",
+    "introspect",
     "is_enabled",
     "is_payload_path",
     "kill_host",

@@ -37,6 +37,7 @@ accounting, never by pretending RAM is storage.
 """
 
 import asyncio
+import time
 from typing import Optional
 
 from ..io_types import IOReq, StoragePlugin, io_payload, is_not_found_error
@@ -69,6 +70,11 @@ class TieredPlugin(StoragePlugin):
             return
         payload = bytes(io_payload(io_req))
         placed, tag = rt.hot_put(self._root, io_req.path, payload)
+        # The ack moment: hot_put returned — from here the object's
+        # durability-lag clock runs (ack → drained, per object), fed to
+        # the runtime alongside the payload size so the sampler's
+        # at-risk accounting needs no tier re-probe.
+        ack_t = time.monotonic()
         if placed < rt.k:
             # The ack-at-k contract cannot be met from RAM (dead or
             # full peers, spare hosts included): degrade to a
@@ -91,9 +97,17 @@ class TieredPlugin(StoragePlugin):
                     self._root, io_req.path, tag, placed
                 )
                 raise
-            rt.note_write_through(self._root, io_req.path, tag, placed)
+            rt.note_write_through(
+                self._root, io_req.path, tag, placed, nbytes=len(payload)
+            )
             return
-        rt.enqueue_drain(self._root, io_req.path, tag)
+        rt.enqueue_drain(
+            self._root,
+            io_req.path,
+            tag,
+            nbytes=len(payload),
+            ack_t=ack_t,
+        )
 
     async def read(self, io_req: IOReq) -> None:
         rt = self._runtime

@@ -45,6 +45,8 @@ real retry policy.
 import asyncio
 import json
 import logging
+import os
+import socket
 import threading
 import time
 from collections import deque
@@ -104,8 +106,22 @@ class _RootState:
         # was queued or in flight) is recognized by the mismatch and
         # neither clears pending nor marks the new replicas evictable.
         self.tags: Dict[str, str] = {}
+        # Ack timestamp (monotonic) and payload size per pending path:
+        # the raw material of the durability-lag accounting (ack →
+        # drained per object) and the sampler's at-risk-bytes view.
+        self.ack_t: Dict[str, float] = {}
+        self.sizes: Dict[str, int] = {}
+        # Per-root tier-down progress: bytes enqueued for drain vs bytes
+        # already durable (drained or written through) — what the
+        # background drain's .progress/tierdown/<rank> records render.
+        self.enqueued_bytes = 0
+        self.drained_bytes = 0
         self.committed = False  # .snapshot_metadata observed
+        self.commit_t: Optional[float] = None  # monotonic, at on_commit
         self.tierdown_done = False
+        # Per-take durability lag (commit ack → .tierdown), recorded
+        # when the watermark lands; also stamped INTO the watermark.
+        self.durability_lag_s: Optional[float] = None
         self.drain_lost = 0  # objects whose every replica died pre-drain
         self.drained_objects = 0  # THIS root's objects tiered down
         self.write_through = 0  # THIS root's objects written through
@@ -188,6 +204,17 @@ class HotTierRuntime:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.drain_error: Optional[BaseException] = None
+        # Drain executor heartbeat (monotonic): refreshed at every loop
+        # iteration of the background drainer (and drain_now); the
+        # sampler derives "drain event-loop lag" from its age while the
+        # queue is non-empty.
+        self._drain_beat: Optional[float] = None
+        # Tier-down progress publication state, per root (background
+        # drain only — manual mode keeps the op stream deterministic
+        # for the fault harness): last emit monotonic, seq, started-at.
+        self._progress_emit: Dict[str, float] = {}
+        self._progress_seq: Dict[str, int] = {}
+        self._progress_start: Dict[str, float] = {}
         # Cumulative counters (stats_snapshot/delta power the per-restore
         # tier summary; concurrent operations smear, same contract as the
         # process-wide telemetry counters).
@@ -331,11 +358,18 @@ class HotTierRuntime:
             if state is not None:
                 state.pending.discard(path)
                 state.tags.pop(path, None)
+                state.ack_t.pop(path, None)
+                state.sizes.pop(path, None)
                 state.stranded.discard(path)
             self._cond.notify_all()
 
     def note_write_through(
-        self, root: str, path: str, tag: Optional[str], placed: int
+        self,
+        root: str,
+        path: str,
+        tag: Optional[str],
+        placed: int,
+        nbytes: Optional[int] = None,
     ) -> None:
         """The object was written through to the durable tier
         synchronously before ack — either no replica landed (placed ==
@@ -350,6 +384,7 @@ class HotTierRuntime:
         key = self._key(root, path)
         degraded = 0 < placed < self.k
         watermark_due = False
+        now = time.monotonic()
         with self._cond:
             self._stats["write_through"] += 1
             if degraded:
@@ -357,6 +392,22 @@ class HotTierRuntime:
             self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
             state.write_through += 1
+            # Ack→durable lag of a write-through: 0 unless the path
+            # carried a pending obligation from an earlier ack (a
+            # re-armed degraded write) — the object is durable AT its
+            # ack, which is the whole point of the degraded path.
+            ack = state.ack_t.pop(path, None)
+            object_lag_s = max(0.0, now - ack) if ack is not None else 0.0
+            size = state.sizes.pop(path, None)
+            if nbytes is None:
+                nbytes = size
+            if nbytes is not None:
+                if path not in state.pending and size is None:
+                    # Brand-new write-through (never enqueued): count it
+                    # into the root's tier-down progress totals so
+                    # bytes_done/total stay commensurable.
+                    state.enqueued_bytes += nbytes
+                state.drained_bytes += nbytes
             state.pending.discard(path)
             state.tags.pop(path, None)
             state.stranded.discard(path)
@@ -376,6 +427,9 @@ class HotTierRuntime:
         if tag is not None:
             tier.mark_drained(key, tag)
         telemetry.counter(_metric_names.HOT_TIER_WRITE_THROUGH).inc()
+        telemetry.histogram(_metric_names.HOT_TIER_OBJECT_LAG).observe(
+            object_lag_s
+        )
         if degraded:
             telemetry.counter(_metric_names.HOT_TIER_DEGRADED_PUTS).inc()
             logger.warning(
@@ -387,11 +441,23 @@ class HotTierRuntime:
             self._ensure_thread()
 
     def enqueue_drain(
-        self, root: str, path: str, tag: Optional[str] = None
+        self,
+        root: str,
+        path: str,
+        tag: Optional[str] = None,
+        nbytes: Optional[int] = None,
+        ack_t: Optional[float] = None,
     ) -> None:
+        """``nbytes``/``ack_t`` (new writes: the payload size and the
+        ack moment, stamped by the plugin) feed the durability-lag and
+        at-risk accounting; a re-arm (abort_write_through, stranded
+        re-drive) passes neither — the ORIGINAL ack keeps the clock, the
+        obligation is as old as the ack that created it."""
         root = root.rstrip("/")
         if tag is None:
             tag = tier.key_tag(self._key(root, path))
+        if nbytes is None:
+            nbytes = tier.key_size_bytes(self._key(root, path))
         with self._cond:
             self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
@@ -400,6 +466,18 @@ class HotTierRuntime:
             was_stranded = path in state.stranded
             state.stranded.discard(path)
             state.pending.add(path)
+            if ack_t is not None or path not in state.ack_t:
+                state.ack_t[path] = (
+                    ack_t if ack_t is not None else time.monotonic()
+                )
+            if nbytes is not None:
+                if not was_pending:
+                    state.enqueued_bytes += nbytes
+                elif path in state.sizes:
+                    # Re-write while pending: the root's total tracks
+                    # the NEWEST bytes at each path.
+                    state.enqueued_bytes += nbytes - state.sizes[path]
+                state.sizes[path] = nbytes
             if tag is not None:
                 state.tags[path] = tag
             if was_pending:
@@ -448,6 +526,10 @@ class HotTierRuntime:
             self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
             state.committed = True
+            if state.commit_t is None:
+                # The take's ack point: the durability-lag clock the
+                # .tierdown watermark closes starts here.
+                state.commit_t = time.monotonic()
             if not state.pending and not state.tierdown_done:
                 self._queue.append((root, None, None, 0))
                 self._cond.notify_all()
@@ -550,6 +632,8 @@ class HotTierRuntime:
             if state is not None and path in state.pending:
                 state.pending.discard(path)
                 state.tags.pop(path, None)
+                state.ack_t.pop(path, None)
+                state.sizes.pop(path, None)
                 state.stranded.discard(path)
                 self._cancel_queued_locked(root, path)
                 existed = True
@@ -669,6 +753,7 @@ class HotTierRuntime:
             while True:
                 with self._cond:
                     while True:
+                        self._drain_beat = time.monotonic()
                         item = self._pop_runnable_locked()
                         if item is not None:
                             break
@@ -737,6 +822,7 @@ class HotTierRuntime:
                             self._cond.wait(timeout=0.2)
                         if not self._queue:
                             return
+                    self._drain_beat = time.monotonic()
                     item = self._pop_runnable_locked()
                     if item is None:
                         # Everything queued is deferred behind an
@@ -854,6 +940,8 @@ class HotTierRuntime:
                     if state is not None:
                         state.pending.discard(path)
                         state.tags.pop(path, None)
+                        state.ack_t.pop(path, None)
+                        state.sizes.pop(path, None)
                         state.drain_lost += 1
             if requeued:
                 # Give a mid-flight foreground re-write time to land
@@ -900,6 +988,8 @@ class HotTierRuntime:
         # a re-write racing this drain keeps ITS replicas pinned until
         # its own item lands.
         tier.mark_drained(key, data_tag)
+        now = time.monotonic()
+        object_lag_s: Optional[float] = None
         with self._cond:
             forgotten = root in self._forgotten
             state = self._roots.get(root)
@@ -917,11 +1007,23 @@ class HotTierRuntime:
             if current:
                 state.pending.discard(path)
                 state.tags.pop(path, None)
+                ack = state.ack_t.pop(path, None)
+                state.sizes.pop(path, None)
+                if ack is not None:
+                    object_lag_s = max(0.0, now - ack)
+                state.drained_bytes += len(data)
                 state.drained_objects += 1
         if current and not forgotten:
             telemetry.counter(_metric_names.HOT_TIER_DRAINED_BYTES).inc(
                 len(data)
             )
+            if object_lag_s is not None:
+                # The per-object durability-lag distribution: how long
+                # each acked object rested on RAM replicas alone.
+                telemetry.histogram(
+                    _metric_names.HOT_TIER_OBJECT_LAG
+                ).observe(object_lag_s)
+            self._publish_drain_progress(plugin, root)
         if forgotten:
             # The snapshot was deleted while our durable write was in
             # flight: the object must not outlive it as durable garbage.
@@ -958,6 +1060,15 @@ class HotTierRuntime:
                 return
             drained_objects = state.drained_objects
             write_through = state.write_through
+            commit_t = state.commit_t
+        # Per-take durability lag: the take's ack (its metadata commit,
+        # observed by on_commit) → this watermark. THE number that
+        # bounds the RPO exposure window the hot tier opened.
+        durability_lag_s = (
+            round(max(0.0, time.monotonic() - commit_t), 6)
+            if commit_t is not None
+            else None
+        )
         emit_storage_op("hottier.tierdown", TIERDOWN_FNAME)
         # Counts are THIS root's and THIS process's: in a multi-rank job
         # every metadata-writing process records its own drain progress;
@@ -968,6 +1079,7 @@ class HotTierRuntime:
             "format_version": 1,
             "drained_objects": drained_objects,
             "write_through_objects": write_through,
+            "durability_lag_s": durability_lag_s,
             "scope": "process",
             "ts_epoch_s": round(time.time(), 3),
         }
@@ -1004,6 +1116,7 @@ class HotTierRuntime:
             state = self._roots.get(root)
             if state is not None:
                 state.tierdown_done = True
+                state.durability_lag_s = durability_lag_s
             self._cond.notify_all()
         if forgotten:
             # Deleted mid-watermark-write: take the marker back out.
@@ -1014,6 +1127,282 @@ class HotTierRuntime:
                     f"hot-tier drain: undo of {root}/{TIERDOWN_FNAME} "
                     f"after delete failed: {e!r}"
                 )
+            return
+        if durability_lag_s is not None:
+            telemetry.histogram(_metric_names.HOT_TIER_TAKE_LAG).observe(
+                durability_lag_s
+            )
+        # Post-watermark observability fan-out, all best-effort: stamp
+        # durability_lag_s into the take's flight report, append the
+        # drain event record to the telemetry ledger (the "null until
+        # drained" contract — ledger.py), and retire the tier-down
+        # progress record. None of it may fail the drain.
+        self._annotate_report_lag(plugin, durability_lag_s)
+        self._append_tierdown_ledger(
+            root, durability_lag_s, drained_objects, write_through
+        )
+        self._retire_drain_progress(plugin, root)
+
+    # ------------------------------------------- tier-down observability
+    #
+    # Everything below is observability fan-out from the drain pipeline:
+    # best-effort by contract (an Exception is logged, never propagated
+    # — a SimulatedCrash still rips through like everywhere else), and
+    # the live-progress records are BACKGROUND-mode only so the manual
+    # fault harness keeps its deterministic op stream.
+
+    _DRAIN_PROGRESS_TAKE_ID = "tierdown"
+
+    def _drain_progress_path(self) -> str:
+        return f".progress/{self._DRAIN_PROGRESS_TAKE_ID}/{self.rank}"
+
+    def _publish_drain_progress(
+        self, plugin: Any, root: str, force: bool = False
+    ) -> None:
+        """Publish ``root``'s tier-down progress record (phase
+        ``tierdown``, bytes drained/total) to
+        ``.progress/tierdown/<rank>`` in the root's own prefix — the
+        same transport and lifecycle as take/restore progress records,
+        so ``watch``/``ops`` show the background drain instead of going
+        dark after commit. Rate-limited on the progress cadence;
+        swept by :meth:`_retire_drain_progress` at the watermark, by
+        ``Snapshot.delete``, and by ``reconcile`` like all ``.progress``
+        debris."""
+        if self.drain_mode != "background":
+            return
+        from ..telemetry import progress as liveprog
+
+        now = time.monotonic()
+        if not force:
+            last = self._progress_emit.get(root, 0.0)
+            if now - last < liveprog._interval_s():
+                return
+        self._progress_emit[root] = now
+        with self._cond:
+            state = self._roots.get(root)
+            if state is None:
+                return
+            seq = self._progress_seq.get(root, 0) + 1
+            self._progress_seq[root] = seq
+            started = self._progress_start.setdefault(root, time.time())
+            record = {
+                "format_version": liveprog.PROGRESS_FORMAT_VERSION,
+                "kind": "tierdown",
+                "path": root,
+                "take_id": self._DRAIN_PROGRESS_TAKE_ID,
+                "rank": self.rank,
+                "world_size": self.world,
+                "phase": "tierdown",
+                "bytes_done": state.drained_bytes,
+                "bytes_total": state.enqueued_bytes or None,
+                "ops": {
+                    "drain": state.drained_objects,
+                    "write_through": state.write_through,
+                },
+                "retries": 0,
+                "seq": seq,
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+                "started_at": round(started, 3),
+                "heartbeat_at": round(time.time(), 3),
+            }
+        try:
+            asyncio.run(
+                plugin.write(
+                    IOReq(
+                        path=self._drain_progress_path(),
+                        data=json.dumps(record, sort_keys=True).encode(
+                            "utf-8"
+                        ),
+                    )
+                )
+            )
+        except Exception as e:
+            logger.debug("tier-down progress write failed: %r", e)
+
+    def _retire_drain_progress(self, plugin: Any, root: str) -> None:
+        """The root fully tiered down: its progress record describes a
+        finished operation — remove it (the drainer is the record's sole
+        writer, so unlike take records there is no later sweep point and
+        no republish race)."""
+        if self.drain_mode != "background":
+            return
+        self._progress_emit.pop(root, None)
+        self._progress_seq.pop(root, None)
+        self._progress_start.pop(root, None)
+        try:
+            asyncio.run(plugin.delete(self._drain_progress_path()))
+        except Exception as e:
+            logger.debug("tier-down progress cleanup failed: %r", e)
+
+    def _annotate_report_lag(
+        self, plugin: Any, durability_lag_s: Optional[float]
+    ) -> None:
+        """Back-fill ``durability_lag_s`` into the committed take's
+        ``.report.json`` so ``inspect --doctor`` (the
+        ``durability-lag-above-budget`` rule) sees the closed exposure
+        window. Best-effort: the report may not exist yet (a fast drain
+        racing the commit route's report write) — the ledger's tierdown
+        record still carries the number."""
+        if durability_lag_s is None:
+            return
+        from ..io_types import io_payload as _io_payload
+        from ..telemetry import report as flight
+
+        try:
+
+            async def _annotate() -> None:
+                io_req = IOReq(path=flight.REPORT_FNAME)
+                await plugin.read(io_req)
+                doc = json.loads(bytes(_io_payload(io_req)).decode("utf-8"))
+                doc["durability_lag_s"] = durability_lag_s
+                await plugin.write(
+                    IOReq(
+                        path=flight.REPORT_FNAME,
+                        data=json.dumps(
+                            doc, indent=2, sort_keys=True
+                        ).encode("utf-8"),
+                    )
+                )
+
+            asyncio.run(_annotate())
+        except Exception as e:
+            logger.debug(
+                "durability-lag report annotation skipped: %r", e
+            )
+
+    def _append_tierdown_ledger(
+        self,
+        root: str,
+        durability_lag_s: Optional[float],
+        drained_objects: int,
+        write_through: int,
+    ) -> None:
+        """Append the drain event record (kind ``tierdown``) to the
+        telemetry ledger: the take's own digest carries
+        ``durability_lag_s: null`` (it is written at commit, when the
+        window is still open); this record closes it."""
+        from ..telemetry import ledger as runledger
+
+        try:
+            runledger.append_for_snapshot(
+                root,
+                runledger.tierdown_record(
+                    path=root,
+                    durability_lag_s=durability_lag_s,
+                    drained_objects=drained_objects,
+                    write_through_objects=write_through,
+                ),
+            )
+        except Exception as e:
+            telemetry.counter(_metric_names.LEDGER_APPEND_FAILURES).inc()
+            logger.warning("tierdown ledger append failed: %r", e)
+
+    # ----------------------------------------------------- introspection
+
+    def introspect(self) -> Dict[str, Any]:
+        """Lock-consistent snapshot of the drain pipeline's live state —
+        what the runtime sampler (telemetry/sampler.py), the ops view,
+        and tests consume. One pass under the runtime lock (per-host
+        occupancy is read from the tier's own lock afterwards, so the
+        two sections are each self-consistent)."""
+        now = time.monotonic()
+        with self._cond:
+            queued_objects = sum(
+                1 for it in self._queue if it[1] is not None
+            )
+            queued_watermarks = len(self._queue) - queued_objects
+            roots: Dict[str, Any] = {}
+            at_risk_bytes = 0
+            at_risk_by_root: Dict[str, int] = {}
+            pending_objects = 0
+            stranded_objects = 0
+            stranded_roots: List[str] = []
+            oldest_age: Optional[float] = None
+            oldest_at_risk_age: Optional[float] = None
+            for root, st in sorted(self._roots.items()):
+                pending_bytes = sum(
+                    st.sizes.get(p, 0) for p in st.pending
+                )
+                pending_objects += len(st.pending)
+                stranded_objects += len(st.stranded)
+                if st.stranded or st.tierdown_stranded:
+                    stranded_roots.append(root)
+                at_risk = st.committed and not st.tierdown_done
+                if at_risk:
+                    at_risk_bytes += pending_bytes
+                    if pending_bytes:
+                        at_risk_by_root[root] = pending_bytes
+                for p in st.pending:
+                    t = st.ack_t.get(p)
+                    if t is not None:
+                        age = max(0.0, now - t)
+                        if oldest_age is None or age > oldest_age:
+                            oldest_age = age
+                        # The RPO-relevant age is COMMITTED roots only:
+                        # an in-flight take's pending objects are not
+                        # an acked checkpoint's exposure window, and
+                        # pairing their age with another root's at-risk
+                        # bytes would fire a false lag alert.
+                        if at_risk and (
+                            oldest_at_risk_age is None
+                            or age > oldest_at_risk_age
+                        ):
+                            oldest_at_risk_age = age
+                roots[root] = {
+                    "committed": st.committed,
+                    "tierdown_done": st.tierdown_done,
+                    "pending_objects": len(st.pending),
+                    "pending_bytes": pending_bytes,
+                    "stranded_objects": len(st.stranded),
+                    "tierdown_stranded": st.tierdown_stranded,
+                    "drain_lost": st.drain_lost,
+                    "drained_bytes": st.drained_bytes,
+                    "enqueued_bytes": st.enqueued_bytes,
+                    "durability_lag_s": st.durability_lag_s,
+                }
+            beat = self._drain_beat
+            doc: Dict[str, Any] = {
+                "rank": self.rank,
+                "world": self.world,
+                "k": self.k,
+                "drain_mode": self.drain_mode,
+                "queue_depth": queued_objects,
+                "queued_watermarks": queued_watermarks,
+                "inflight": self._inflight,
+                "pending_objects": pending_objects,
+                "oldest_pending_age_s": (
+                    round(oldest_age, 3) if oldest_age is not None else None
+                ),
+                "oldest_at_risk_age_s": (
+                    round(oldest_at_risk_age, 3)
+                    if oldest_at_risk_age is not None
+                    else None
+                ),
+                "at_risk_bytes": at_risk_bytes,
+                "at_risk_by_root": at_risk_by_root,
+                "stranded_objects": stranded_objects,
+                "stranded_roots": stranded_roots,
+                "drain_error": (
+                    repr(self.drain_error)
+                    if self.drain_error is not None
+                    else None
+                ),
+                "drain_heartbeat_age_s": (
+                    round(max(0.0, now - beat), 3)
+                    if beat is not None
+                    else None
+                ),
+                "roots": roots,
+                "stats": dict(self._stats),
+            }
+        doc["hosts"] = {
+            str(h): occ for h, occ in tier.host_occupancy().items()
+        }
+        telemetry.gauge(_metric_names.HOT_TIER_AT_RISK_BYTES).set(
+            float(at_risk_bytes)
+        )
+        return doc
 
     def _dirty_pending_locked(self) -> bool:
         """``_cond`` held: is any pending path NOT accounted for by
@@ -1220,8 +1609,28 @@ def reset_pending() -> None:
         rt._queue.clear()
         rt._roots.clear()
         rt._forgotten.clear()
+        rt._progress_emit.clear()
+        rt._progress_seq.clear()
+        rt._progress_start.clear()
         rt.drain_error = None
         rt._cond.notify_all()
+
+
+def introspect() -> Optional[Dict[str, Any]]:
+    """Live drain-pipeline state (:meth:`HotTierRuntime.introspect`),
+    or None when the tier is disabled — the sampler/ops entry point."""
+    rt = _RUNTIME
+    return rt.introspect() if rt is not None and rt.active else None
+
+
+def durability_lag_s(root: str) -> Optional[float]:
+    """The recorded per-take durability lag (commit ack → ``.tierdown``)
+    for ``root``: None until the watermark landed (or tier disabled)."""
+    rt = _RUNTIME
+    if rt is None:
+        return None
+    state = rt.root_state(root)
+    return state.durability_lag_s if state is not None else None
 
 
 def forget_root(root: str) -> int:

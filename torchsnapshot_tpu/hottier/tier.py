@@ -352,3 +352,25 @@ def keys_for_root(root: str) -> List[str]:
 def total_buffered_bytes() -> int:
     with _TIER_LOCK:
         return sum(s.used_bytes for s in _HOSTS.values())
+
+
+def host_occupancy() -> Dict[int, Dict[str, object]]:
+    """Per-host occupancy for the runtime sampler / ops view: used vs
+    capacity bytes, liveness, object count, and the undrained share —
+    the bytes that are pinned (unevictable) because the durable tier
+    does not hold them yet. One pass under the tier lock, so the view
+    is self-consistent."""
+    with _TIER_LOCK:
+        out: Dict[int, Dict[str, object]] = {}
+        for host_id, store in sorted(_HOSTS.items()):
+            undrained = sum(
+                len(o.data) for o in store.objects.values() if not o.drained
+            )
+            out[host_id] = {
+                "alive": store.alive,
+                "used_bytes": store.used_bytes,
+                "capacity_bytes": store.capacity_bytes,
+                "objects": len(store.objects),
+                "undrained_bytes": undrained,
+            }
+        return out

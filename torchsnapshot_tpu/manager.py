@@ -428,14 +428,17 @@ class CheckpointManager:
         in flight, which the age guard protects). An uncommitted step's
         sweep reclaims them too; this pass additionally covers COMMITTED
         steps whose post-commit cleanup lost a race with a crash, which
-        no sweep would ever revisit."""
+        no sweep would ever revisit. ``step-<N>/.scope/rank<R>`` sampler
+        records (telemetry/sampler.py) get the identical treatment:
+        live operational state whose writer crashed is debris, and only
+        this pass ever revisits a committed step."""
         import re
 
-        pat = re.compile(r"^step-\d+/\.progress/")
+        pat = re.compile(r"^step-\d+/(\.progress|\.scope)/")
         self._sweep_aged_objects(
             storage,
             [obj for obj in objs if pat.match(obj)],
-            "orphaned progress record",
+            "orphaned progress/scope record",
         )
 
     # -------------------------------------------------------------- save

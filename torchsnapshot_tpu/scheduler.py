@@ -198,6 +198,15 @@ async def execute_write_reqs(
     bytes_written = 0
     max_io = storage.max_write_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
+    # Live budget gauges (snapscope): occupancy + stalled-right-now, so
+    # the runtime sampler can see budget pressure while it happens
+    # instead of post-hoc from the stall counter. Reset on exit.
+    in_use_gauge = telemetry.gauge(
+        _metric_names.SCHED_BUDGET_IN_USE, pipeline="write"
+    )
+    stalled_gauge = telemetry.gauge(
+        _metric_names.SCHED_BUDGET_STALLED, pipeline="write"
+    )
     try:
         while pending or staged or staging or io_tasks:
             # Dispatch staging while the budget allows; always keep at
@@ -259,6 +268,8 @@ async def execute_write_reqs(
                 task = asyncio.ensure_future(_write())
                 io_tasks[task] = len(buf)
 
+            in_use_gauge.set(memory_budget_bytes - budget)
+            stalled_gauge.set(1.0 if budget_blocked else 0.0)
             in_flight = set(staging) | set(io_tasks)
             if not in_flight:
                 continue
@@ -285,6 +296,8 @@ async def execute_write_reqs(
                 await progress.async_tick()
     finally:
         executor.shutdown(wait=False)
+        in_use_gauge.set(0)
+        stalled_gauge.set(0)
     elapsed = time.monotonic() - begin_ts
     _merge_stats(
         stats,
@@ -387,6 +400,12 @@ async def execute_read_reqs(
     bytes_read = 0
     max_io = storage.max_read_concurrency
     executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
+    in_use_gauge = telemetry.gauge(
+        _metric_names.SCHED_BUDGET_IN_USE, pipeline="read"
+    )
+    stalled_gauge = telemetry.gauge(
+        _metric_names.SCHED_BUDGET_STALLED, pipeline="read"
+    )
     try:
         while pending or reading or consumable or consuming:
             budget_blocked = False
@@ -477,6 +496,8 @@ async def execute_read_reqs(
                 consume_task = asyncio.ensure_future(_consume())
                 consuming[consume_task] = host_refund
 
+            in_use_gauge.set(memory_budget_bytes - budget.value)
+            stalled_gauge.set(1.0 if budget_blocked else 0.0)
             in_flight = set(reading) | set(consuming)
             if not in_flight:
                 continue
@@ -500,6 +521,8 @@ async def execute_read_reqs(
                 await progress.async_tick()
     finally:
         executor.shutdown(wait=False)
+        in_use_gauge.set(0)
+        stalled_gauge.set(0)
     elapsed = time.monotonic() - begin_ts
     _merge_stats(
         stats,

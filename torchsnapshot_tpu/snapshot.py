@@ -960,6 +960,17 @@ class Snapshot:
             )
             if own_progress:
                 markers = markers + list(own_progress)
+            # Runtime-sampler scope records (.scope/rank<N>) are live
+            # operational state, not snapshot data: like progress
+            # records they must never survive the snapshot they
+            # describe (telemetry/sampler.py).
+            from .telemetry import sampler as runscope
+
+            own_scope = asyncio.run(
+                storage.list_prefix(runscope.SCOPE_PREFIX + "/")
+            )
+            if own_scope:
+                markers = markers + list(own_scope)
             # A BARE snapshot's telemetry ledger lives in its own prefix
             # and goes with it — deleting the snapshot must not orphan
             # a .telemetry/ stub. (CheckpointManager runs ledger at the

@@ -39,6 +39,20 @@ Three layers, smallest first:
   ``python -m torchsnapshot_tpu.telemetry.timeline <base>`` renders
   per-step trends from the ledger (or a dir of BENCH_*.json) and runs
   a median/MAD regression sentinel; exit 0/1/2 for CI.
+- **Runtime sampler / snapscope** (:mod:`.sampler`) — a crash-isolated
+  background thread snapshotting live runtime state (hot-tier drain
+  queue/at-risk bytes/host occupancy, scheduler budget, goodput) into
+  a bounded ring + ``rank<N>.scope.jsonl`` statusfiles + optional
+  ``.scope/rank<N>`` storage objects.
+- **SLO engine** (:mod:`.slo`) — declarative objectives (durability
+  lag, checkpoint overhead, restore seconds, take GB/s floor) with
+  multi-window burn rates over the ledger plus live sampler rules
+  (``durability-lag-above-budget``, ``drain-backlog-growing``,
+  ``stranded-drains``); CI exit-code contract like ``timeline``'s.
+- **Ops view** (:mod:`.ops`) —
+  ``python -m torchsnapshot_tpu.telemetry.ops <path>`` merges live
+  progress, sampler state, SLO status, and doctor findings into one
+  per-rank operational display (dir and storage-URL modes).
 
 NOTE: :mod:`.report` is deliberately NOT imported here — it depends on
 ``io_types``, which itself records metrics through this package; keeping

@@ -33,6 +33,13 @@ above-budget              TPUSNAPSHOT_CKPT_BUDGET_PCT (default 5%)
 missing-rank-summary      a rank's summary never arrived (null)
 hot-tier-degraded         a restore fell back to the durable tier for
                           >0 objects (critical when >50% of bytes)
+durability-lag-above-     the take's ack→.tierdown window (stamped into
+budget                    the report by the hot tier's drain) exceeded
+                          TPUSNAPSHOT_SLO_DURABILITY_LAG_S (default
+                          120s; critical at 2x). The SLO engine
+                          (telemetry/slo.py) fires the same rule id
+                          LIVE from sampler state, before the
+                          watermark exists to prove it post-hoc.
 ========================  =============================================
 
 Findings are observability, not judgment: every rule errs toward
@@ -386,6 +393,44 @@ def _rule_checkpoint_overhead(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_durability_lag(report: Dict[str, Any]) -> Optional[Finding]:
+    """The hot tier's drain back-fills ``durability_lag_s`` (take ack →
+    ``.tierdown``) into the committed report once the root fully tiers
+    down; a window past the RPO budget means acked checkpoints rested
+    on RAM replicas longer than the stated objective allows."""
+    if report.get("kind") not in ("take", "async_take"):
+        return None
+    lag = report.get("durability_lag_s")
+    if not isinstance(lag, (int, float)):
+        return None
+    from .slo import DURABILITY_LAG_ENV_VAR, durability_lag_budget_s
+
+    budget_s = durability_lag_budget_s()
+    if budget_s <= 0 or lag <= budget_s:
+        return None
+    return Finding(
+        rule="durability-lag-above-budget",
+        severity="critical" if lag >= 2 * budget_s else "warn",
+        title=(
+            f"take stayed undrained for {lag:.1f}s after its ack "
+            f"(durability-lag budget {budget_s:g}s)"
+        ),
+        evidence={
+            "durability_lag_s": round(float(lag), 3),
+            "budget_s": budget_s,
+            "take_id": report.get("take_id"),
+        },
+        remediation=(
+            "the ack→.tierdown exposure window exceeded the RPO "
+            "budget: a correlated host loss in that window would have "
+            "cost an acked checkpoint. Tier-down bandwidth is below "
+            "the take cadence — lower the save frequency, use "
+            "incremental takes, check durable-backend health, or "
+            f"re-state the budget ({DURABILITY_LAG_ENV_VAR})."
+        ),
+    )
+
+
 def _rule_missing_summary(report: Dict[str, Any]) -> Optional[Finding]:
     ranks = report.get("ranks") or []
     missing = [i for i, s in enumerate(ranks) if not s]
@@ -476,6 +521,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_straggler,
     _rule_imbalanced_stripe,
     _rule_checkpoint_overhead,
+    _rule_durability_lag,
     _rule_missing_summary,
     _rule_hot_tier_degraded,
 ]

@@ -72,7 +72,29 @@ source operation did not produce them::
                "fallback_bytes", "degraded_peers": [host, ...]} | null,
                                          # hot-tier attribution (restores
                                          # with the hot tier enabled)
+      "durability_lag_s": null,          # ALWAYS null on take records —
+                                         # the digest is written at commit,
+                                         # while the ack→.tierdown window
+                                         # is still open; the hot tier's
+                                         # drain closes it by APPENDING a
+                                         # separate drain event record
+                                         # (below), never by rewriting
+                                         # committed history
       "doctor": ["<rule id>", ...]       # rules that fired on the report
+    }
+
+Drain event record (kind ``tierdown``, appended by the hot tier's drain
+when a committed root's ``.tierdown`` watermark lands — the chosen
+alternative to back-filling the take record, keeping the ledger strictly
+append-only)::
+
+    {
+      "format_version": 1,
+      "kind": "tierdown",
+      "ts_epoch_s": ..., "path": "<snapshot url>", "step": <int | null>,
+      "take_id": null,
+      "durability_lag_s": ...,           # commit ack -> .tierdown
+      "drained_objects": ..., "write_through_objects": ...
     }
 """
 
@@ -536,5 +558,36 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "goodput": goodput,
         "churn": _churn_totals(summaries, nbytes),
         "tier": _tier_totals(summaries),
+        # Null by construction at commit time (see the schema note);
+        # the hot tier's drain appends a `tierdown` event record that
+        # carries the closed window.
+        "durability_lag_s": None,
         "doctor": doctor_rules,
+    }
+
+
+def tierdown_record(
+    path: str,
+    durability_lag_s: Optional[float],
+    drained_objects: int = 0,
+    write_through_objects: int = 0,
+    take_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The drain event record (kind ``tierdown``) the hot tier appends
+    when a committed root fully tiers down — the ledger's durable copy
+    of the durability-lag measurement (timeline/slo fold over it)."""
+    return {
+        "format_version": LEDGER_FORMAT_VERSION,
+        "kind": "tierdown",
+        "ts_epoch_s": round(time.time(), 3),
+        "path": path,
+        "step": None,  # stamped by append_for_snapshot
+        "take_id": take_id,
+        "durability_lag_s": (
+            round(float(durability_lag_s), 6)
+            if durability_lag_s is not None
+            else None
+        ),
+        "drained_objects": int(drained_objects),
+        "write_through_objects": int(write_through_objects),
     }

@@ -359,3 +359,27 @@ HOT_TIER_DEGRADED_PUTS = (
     "tpusnapshot_hot_tier_degraded_puts_total"  # counter
 )
 HOT_TIER_BUFFERED_BYTES = "tpusnapshot_hot_tier_buffered_bytes"  # gauge
+# Durability-lag accounting (snapscope): per-object ack→drained, the
+# per-take commit-ack→.tierdown window, and the live undrained bytes of
+# committed roots (the RPO exposure the sampler/SLO engine bound).
+HOT_TIER_OBJECT_LAG = (
+    "tpusnapshot_hot_tier_object_durability_lag_seconds"  # hist
+)
+HOT_TIER_TAKE_LAG = (
+    "tpusnapshot_hot_tier_take_durability_lag_seconds"  # hist
+)
+HOT_TIER_AT_RISK_BYTES = "tpusnapshot_hot_tier_at_risk_bytes"  # gauge
+# Live scheduler budget state (snapscope): bytes currently charged
+# against the per-process memory budget and whether the pipeline is
+# stalled on it RIGHT NOW (0/1) — the point-in-time companions of the
+# stall-seconds counter and high-water gauge above.
+SCHED_BUDGET_IN_USE = (
+    "tpusnapshot_scheduler_budget_in_use_bytes"  # gauge {pipeline}
+)
+SCHED_BUDGET_STALLED = (
+    "tpusnapshot_scheduler_budget_stalled"  # gauge {pipeline}
+)
+# Runtime sampler (telemetry/sampler.py): samples recorded and sampler
+# loop errors swallowed (the crash-isolation contract made visible).
+SAMPLER_SAMPLES = "tpusnapshot_sampler_samples_total"  # counter
+SAMPLER_ERRORS = "tpusnapshot_sampler_errors_total"  # counter

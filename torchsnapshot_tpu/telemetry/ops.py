@@ -1,0 +1,307 @@
+"""snapscope's reading half: the unified live-operations view.
+
+``watch`` renders in-flight progress, ``doctor`` diagnoses reports,
+``slo`` judges objectives, the sampler publishes runtime state — four
+views an operator would have to correlate by hand during an incident.
+This CLI merges them into one per-rank operational display::
+
+    python -m torchsnapshot_tpu.telemetry.ops <path> [--json]
+
+``<path>`` is either a snapshot/ledger URL (storage mode: reads
+``.progress/<take_id>/<rank>`` progress objects, ``.scope/rank<N>``
+sampler records, the telemetry ledger, and the committed flight
+reports) or a local live-ops directory (``TPUSNAPSHOT_PROGRESS_DIR``
+statusfiles: ``rank<N>.progress.json`` + ``rank<N>.scope.jsonl``).
+When the hot tier is enabled IN THIS PROCESS the view additionally
+samples the runtime directly, so an embedded caller (or a test) sees
+the drain pipeline with no publishing round-trip.
+
+Sections, each omitted when it has nothing to say:
+
+- **in-flight operations** — ``watch``'s per-rank table (phase, bytes,
+  throughput, ETA, heartbeat staleness), including the hot tier's
+  background ``tierdown`` records, so a drain backlog is visible as a
+  live operation rather than post-commit darkness;
+- **drain pipeline** — per-rank sampler state: queue depth, in-flight,
+  oldest pending-object age, at-risk bytes per committed root,
+  stranded items, drain heartbeat age, per-host replica occupancy;
+- **scheduler** — live memory-budget occupancy / stalled state;
+- **SLOs & findings** — the SLO engine's burn-rate table over the
+  ledger plus its live rules, and any doctor findings from the
+  snapshot's committed reports.
+
+Exit codes (watch-style, CI/pager-facing): 0 = healthy (live work may
+be in flight — a draining backlog is normal operation); 1 = a CRITICAL
+finding is active (stranded drains — the output names the roots —
+durability-lag breach, an SLO burning across both windows, a doctor
+critical); 2 = usage/storage error.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from . import sampler as _sampler
+from . import slo as _slo
+from . import watch as _watch
+from .doctor import Finding, render_findings
+
+_HUMAN = _watch._human_bytes
+
+
+def collect(path: str) -> Dict[str, Any]:
+    """Everything observable at ``path``: progress groups, sampler
+    samples per rank, ledger records, report-based doctor findings.
+    Raises on an unusable path (the CLI maps that to exit 2)."""
+    import os
+
+    state: Dict[str, Any] = {
+        "path": path,
+        "progress": {},
+        "samples_by_rank": {},
+        "ledger_records": [],
+        "report_findings": [],
+    }
+    if "://" not in path and not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no such live-ops directory or snapshot: {path}"
+        )
+    if "://" not in path and os.path.isdir(path) and not os.path.exists(
+        os.path.join(path, ".snapshot_metadata")
+    ):
+        # Local live-ops directory mode (statusfiles only).
+        from . import progress as _progress
+
+        grouped: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        for rank, rec in _progress.collect_statusfiles(path).items():
+            key = f"{rec.get('kind', '?')}:{rec.get('take_id') or 'local'}"
+            grouped.setdefault(key, {})[rank] = rec
+        state["progress"] = grouped
+        state["samples_by_rank"] = _sampler.collect_statusfiles(path)
+    else:
+        from ..storage_plugin import url_to_storage_plugin
+        from . import progress as _progress
+
+        storage = url_to_storage_plugin(path)
+        try:
+            state["progress"] = asyncio.run(
+                _progress.acollect_storage_records(storage)
+            )
+            state["samples_by_rank"] = asyncio.run(
+                _sampler.acollect_storage_records(storage)
+            )
+        finally:
+            storage.close()
+        from . import ledger as _ledger
+
+        try:
+            state["ledger_records"], _ = _ledger.read_records(path)
+        except Exception:  # snapcheck: disable=swallowed-exception -- ledger optional in ops view
+            pass
+        try:
+            from . import doctor as _doctor
+
+            reports = _doctor._collect_snapshot_reports(path)
+            state["report_findings"] = _doctor.diagnose(reports)
+        except Exception:  # snapcheck: disable=swallowed-exception -- reports optional in ops view
+            pass
+    _merge_live_runtime(state)
+    return state
+
+
+def _merge_live_runtime(state: Dict[str, Any]) -> None:
+    """Fold in a direct sample of THIS process's runtime when the hot
+    tier is enabled here — the embedded/test path that needs no
+    publishing round-trip."""
+    from .. import hottier
+
+    rt = hottier.runtime()
+    if rt is None or not rt.active:
+        return
+    try:
+        live = _sampler.RuntimeSampler(rank=rt.rank).build_sample()
+    except Exception:  # snapcheck: disable=swallowed-exception -- live sample is a bonus, never a failure
+        return
+    live["live"] = True
+    state["samples_by_rank"].setdefault(rt.rank, []).append(live)
+
+
+# --------------------------------------------------------------- verdict
+
+
+def findings_of(state: Dict[str, Any]) -> List[Finding]:
+    """Active findings: SLO engine (ledger burn rates + live sampler
+    rules, evaluated per rank — each rank is its own drain pipeline)
+    plus the report-based doctor findings."""
+    result = _slo.evaluate(
+        records=state["ledger_records"],
+        samples_by_rank=state["samples_by_rank"],
+    )
+    state["slo"] = result
+    return list(result["findings"]) + list(state["report_findings"])
+
+
+# -------------------------------------------------------------- rendering
+
+
+def _render_drain_section(state: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    for rank, rank_samples in sorted(state["samples_by_rank"].items()):
+        latest = rank_samples[-1]
+        hot = latest.get("hot_tier")
+        sched = latest.get("scheduler") or {}
+        if hot:
+            backlog = int(hot.get("queue_depth") or 0) + int(
+                hot.get("inflight") or 0
+            )
+            age = hot.get("oldest_pending_age_s")
+            beat = hot.get("drain_heartbeat_age_s")
+            parts = [
+                f"drain backlog {backlog} (queued "
+                f"{hot.get('queue_depth', 0)} + in-flight "
+                f"{hot.get('inflight', 0)})",
+                f"at-risk {_HUMAN(hot.get('at_risk_bytes') or 0)}",
+            ]
+            if age is not None:
+                parts.append(f"oldest item {age:.1f}s")
+            if beat is not None:
+                parts.append(f"drain beat {beat:.1f}s ago")
+            if hot.get("stranded_objects"):
+                parts.append(
+                    f"STRANDED {hot['stranded_objects']} at "
+                    f"{hot.get('stranded_roots')}"
+                )
+            if hot.get("drain_error"):
+                parts.append(f"DRAIN DEAD: {hot['drain_error']}")
+            lines.append(f"rank {rank}: " + ", ".join(parts))
+            for root, nbytes in sorted(
+                (hot.get("at_risk_by_root") or {}).items()
+            ):
+                lines.append(
+                    f"    at-risk root {root}: {_HUMAN(nbytes)} undrained"
+                )
+            hosts = hot.get("hosts") or {}
+            if hosts:
+                occ = " ".join(
+                    f"h{h}:{_HUMAN(o.get('used_bytes') or 0)}/"
+                    f"{_HUMAN(o.get('capacity_bytes') or 0)}"
+                    + ("" if o.get("alive") else "(DEAD)")
+                    for h, o in sorted(hosts.items())
+                )
+                lines.append(f"    hosts: {occ}")
+        for pipeline, s in sorted(sched.items()):
+            if s.get("budget_in_use_bytes") or s.get("stalled"):
+                lines.append(
+                    f"rank {rank}: scheduler {pipeline} budget in use "
+                    f"{_HUMAN(s.get('budget_in_use_bytes') or 0)}"
+                    + (" STALLED" if s.get("stalled") else "")
+                )
+    return lines
+
+
+def render(state: Dict[str, Any], stale_after_s: float) -> str:
+    lines: List[str] = [f"ops view of {state['path']}"]
+    progress = state["progress"]
+    if progress:
+        for key in sorted(progress):
+            lines.append("")
+            lines.append(
+                _watch.render_progress(
+                    progress[key], stale_after_s=stale_after_s
+                )
+            )
+    else:
+        lines.append("no in-flight progress records")
+    drain = _render_drain_section(state)
+    if drain:
+        lines.append("")
+        lines.append("drain pipeline / scheduler:")
+        lines.extend(f"  {line}" for line in drain)
+    slo_result = state.get("slo")
+    if slo_result is not None and slo_result.get("objectives"):
+        lines.append("")
+        lines.append(_slo.render(slo_result, with_findings=False))
+    report_findings = state.get("report_findings") or []
+    slo_findings = (slo_result or {}).get("findings") or []
+    all_findings = list(slo_findings) + list(report_findings)
+    lines.append("")
+    lines.append(render_findings(all_findings))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchsnapshot_tpu.telemetry.ops",
+        description="Unified live-operations view: in-flight progress, "
+        "drain/sampler state, SLO burn rates, doctor findings.",
+    )
+    parser.add_argument(
+        "path",
+        help="snapshot/ledger URL (storage mode) or a local "
+        "TPUSNAPSHOT_PROGRESS_DIR directory (statusfile mode)",
+    )
+    parser.add_argument(
+        "--stale-after",
+        type=float,
+        default=None,
+        metavar="S",
+        help="progress staleness window (default: 3x publish interval)",
+    )
+    parser.add_argument(
+        "--follow",
+        action="store_true",
+        help="keep polling and re-rendering instead of printing once",
+    )
+    parser.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="poll interval for --follow (default 2s)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit JSON")
+    args = parser.parse_args(argv)
+    stale_after = _watch._stale_after_s(args.stale_after)
+    while True:
+        try:
+            state = collect(args.path)
+            findings = findings_of(state)
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        critical = [f for f in findings if f.severity == "critical"]
+        if args.json:
+            doc = {
+                "path": state["path"],
+                "progress": state["progress"],
+                "samples_by_rank": {
+                    str(r): s
+                    for r, s in state["samples_by_rank"].items()
+                },
+                "slo": dict(
+                    state.get("slo") or {},
+                    findings=[
+                        f.as_dict()
+                        for f in (state.get("slo") or {}).get(
+                            "findings", []
+                        )
+                    ],
+                ),
+                "report_findings": [
+                    f.as_dict() for f in state["report_findings"]
+                ],
+                "critical": [f.as_dict() for f in critical],
+            }
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(render(state, stale_after))
+        if not args.follow:
+            return 1 if critical else 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

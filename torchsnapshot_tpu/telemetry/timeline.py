@@ -60,11 +60,24 @@ _RESTORE_METRICS: List[_MetricDef] = [
     ("wall_s", "restore seconds", "high", 0.05, None),
     ("gbps", "restore GB/s", "low", 0.0, None),
 ]
+# Drain event records (kind "tierdown", appended by the hot tier when a
+# committed root fully tiers down): the durability-lag trend — the RPO
+# exposure window creeping up across a run is exactly the regression
+# this sentinel exists to name.
+_DRAIN_METRICS: List[_MetricDef] = [
+    ("durability_lag_s", "durability lag s", "high", 0.05, None),
+]
 _BENCH_METRICS: List[_MetricDef] = [
     ("value", "take GB/s", "low", 0.0, None),
     ("restore_GBps", "restore GB/s", "low", 0.0, None),
     ("take_vs_ceiling", "take/ceiling", "low", 0.05, 0.2),
     ("restore_vs_ceiling", "restore/ceiling", "low", 0.05, 0.2),
+    # PR 6 hot-tier headline numbers, regression-gated like the rest:
+    # the hot-vs-durable restore ratio, the every-step hot-leg goodput
+    # overhead, and the bench take's measured durability lag.
+    ("hot_tier.hot_vs_durable", "hot/durable restore ratio", "low", 0.5, 0.3),
+    ("hot_tier.durability_lag_s", "bench durability lag s", "high", 0.5, None),
+    ("every_step.hot.overhead_pct", "every-step overhead %", "high", 0.5, 0.3),
 ]
 
 
@@ -197,7 +210,8 @@ def _fmt(v: Optional[float], spec: str = "8.3f") -> str:
 def render_ledger(records: List[Dict[str, Any]]) -> List[str]:
     lines = [
         f"{'record':>9s} {'kind':>10s} {'wall_s':>8s} {'GB/s':>8s} "
-        f"{'stall%':>7s} {'retry':>5s} {'churn':>6s} {'goodput':>7s}  doctor"
+        f"{'stall%':>7s} {'retry':>5s} {'churn':>6s} {'goodput':>7s} "
+        f"{'durlag':>7s}  doctor"
     ]
     for i, r in enumerate(records):
         doctor = ",".join(r.get("doctor") or []) or "-"
@@ -210,7 +224,8 @@ def render_ledger(records: List[Dict[str, Any]]) -> List[str]:
             f"{_fmt(_get(r, 'stall_pct'), '7.1f')} "
             f"{_fmt(r.get('retries'), '5.0f')} "
             f"{_fmt(_get(r, 'churn.efficiency'), '6.2f')} "
-            f"{_fmt(goodput_col, '7.3f')}  {doctor}"
+            f"{_fmt(goodput_col, '7.3f')} "
+            f"{_fmt(_get(r, 'durability_lag_s'), '7.2f')}  {doctor}"
         )
     return lines
 
@@ -220,15 +235,25 @@ def analyze_ledger(
 ) -> Dict[str, Any]:
     takes = [r for r in records if r.get("kind") in ("take", "async_take")]
     restores = [r for r in records if r.get("kind") == "restore"]
-    findings = run_sentinel(
-        build_series(takes, _TAKE_METRICS), _TAKE_METRICS, **knobs
-    ) + run_sentinel(
-        build_series(restores, _RESTORE_METRICS), _RESTORE_METRICS, **knobs
+    drains = [r for r in records if r.get("kind") == "tierdown"]
+    findings = (
+        run_sentinel(
+            build_series(takes, _TAKE_METRICS), _TAKE_METRICS, **knobs
+        )
+        + run_sentinel(
+            build_series(restores, _RESTORE_METRICS),
+            _RESTORE_METRICS,
+            **knobs,
+        )
+        + run_sentinel(
+            build_series(drains, _DRAIN_METRICS), _DRAIN_METRICS, **knobs
+        )
     )
     return {
         "n_records": len(records),
         "n_takes": len(takes),
         "n_restores": len(restores),
+        "n_drains": len(drains),
         "doctor_history": doctor_history(records),
         "regressions": findings,
     }
@@ -289,7 +314,8 @@ def render_bench(result: Dict[str, Any]) -> List[str]:
             by_run.setdefault(lab, {})[field] = v
     lines.append(
         f"{'run':>12s} {'take GB/s':>10s} {'restore':>8s} "
-        f"{'take/ceil':>9s} {'rest/ceil':>9s}  gaps"
+        f"{'take/ceil':>9s} {'rest/ceil':>9s} {'hot/dur':>8s} "
+        f"{'es-ovh%':>8s}  gaps"
     )
     for lab in result.get("runs") or []:
         vals = by_run.get(lab, {})
@@ -298,7 +324,10 @@ def render_bench(result: Dict[str, Any]) -> List[str]:
             f"{lab:>12s} {_fmt(vals.get('value'), '10.4f')} "
             f"{_fmt(vals.get('restore_GBps'), '8.4f')} "
             f"{_fmt(vals.get('take_vs_ceiling'), '9.3f')} "
-            f"{_fmt(vals.get('restore_vs_ceiling'), '9.3f')}  {gap}"
+            f"{_fmt(vals.get('restore_vs_ceiling'), '9.3f')} "
+            f"{_fmt(vals.get('hot_tier.hot_vs_durable'), '8.2f')} "
+            f"{_fmt(vals.get('every_step.hot.overhead_pct'), '8.2f')}  "
+            f"{gap}"
         )
     return lines
 
