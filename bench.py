@@ -243,6 +243,7 @@ def _summary_doc() -> dict:
         "incremental": r.get("incremental"),
         "hot_tier": r.get("hot_tier"),
         "every_step": r.get("every_step"),
+        "read_fanout": r.get("read_fanout"),
         "scaling": r.get("scaling"),
         "sharded_cpu": r.get("sharded_cpu"),
         "gaps": r.get("gaps", []),
@@ -795,6 +796,225 @@ def run_every_step_block(
             os.environ.pop("TPUSNAPSHOT_SWEEP_MIN_AGE_S", None)
         else:
             os.environ["TPUSNAPSHOT_SWEEP_MIN_AGE_S"] = prev_age
+
+
+class _SharedRateReadThrottle:
+    """Plugin decorator modeling ONE object store with a fixed egress
+    bandwidth shared by every reader: a global availability pointer
+    (threading-locked — readers run on many event loops) serializes the
+    modeled transfer slots while the sleeps overlap per caller. Reads
+    only (flight-report/ledger writes stay free — the section measures
+    read fan-out). Also the section's backend-byte meter."""
+
+    def __init__(self, inner, shared_state: dict) -> None:
+        self._inner = inner
+        self._shared = shared_state  # {"lock", "avail_at", "rate", "bytes"}
+
+    async def read(self, io_req) -> None:
+        import asyncio
+
+        from torchsnapshot_tpu.io_types import io_payload
+
+        await self._inner.read(io_req)
+        nbytes = len(io_payload(io_req))
+        s = self._shared
+        with s["lock"]:
+            now = time.monotonic()
+            start = max(now, s["avail_at"])
+            s["avail_at"] = start + nbytes / s["rate"]
+            delay = s["avail_at"] - now
+            s["bytes"] += nbytes
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def run_read_fanout_block(
+    payload_bytes: int = 16 << 20,
+    reader_counts=(1, 8, 32),
+    modeled_backend_gbps: float = 0.1,
+    n_params: int = 8,
+) -> dict:
+    """Read fan-out through the snapserve read plane vs direct
+    (snapserve/, ROADMAP item 3): N concurrent readers restore ONE
+    snapshot, once with every reader hitting the backend directly and
+    once through an in-process read service, behind a SHARED modeled
+    object-store egress bandwidth. The certified quantity is
+    backend-byte READ AMPLIFICATION (backend bytes read / snapshot
+    payload bytes): direct costs ~N x, the service's manifest memo +
+    single-flight + content cache must keep it <= 1.2x at the largest
+    N (the ISSUE-9 acceptance bar). Aggregate client GB/s rides along
+    (the service serves cached bytes at RAM speed while direct readers
+    queue on the shared pipe). Host-only numpy payloads — no device in
+    the loop, so the section is tenancy-independent."""
+    import asyncio as _asyncio
+    import uuid as _uuid
+
+    import numpy as np
+
+    from torchsnapshot_tpu import StateDict, snapserve
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    import torchsnapshot_tpu.storage_plugin as _sp_mod
+
+    root = f"memory://bench-fanout-{_uuid.uuid4().hex[:10]}/snap"
+    param_bytes = max(1 << 16, payload_bytes // n_params)
+    n_elems = param_bytes // 4
+    rng = np.random.default_rng(19)
+    reference = {
+        f"p{i}": rng.standard_normal(n_elems).astype(np.float32)
+        for i in range(n_params)
+    }
+    Snapshot.take(root, {"model": StateDict(**reference)})
+    actual_payload = sum(a.nbytes for a in reference.values())
+
+    def _shared_state() -> dict:
+        return {
+            "lock": threading.Lock(),
+            "avail_at": 0.0,
+            "rate": modeled_backend_gbps * 1024**3,
+            "bytes": 0,
+        }
+
+    def _run_group(n_readers: int, make_snapshot) -> dict:
+        """N threads restoring concurrently; returns wall/exactness."""
+        barrier = threading.Barrier(n_readers)
+        spans = [None] * n_readers
+        errors: list = []
+
+        def _one(idx: int) -> None:
+            try:
+                snap = make_snapshot()
+                target = {
+                    "model": StateDict(
+                        **{
+                            k: np.zeros_like(v)
+                            for k, v in reference.items()
+                        }
+                    )
+                }
+                barrier.wait(timeout=60)
+                begin = time.monotonic()
+                snap.restore(target)
+                end = time.monotonic()
+                exact = all(
+                    bool((target["model"][k] == reference[k]).all())
+                    for k in reference
+                )
+                spans[idx] = (begin, end, exact)
+            except Exception as e:  # surfaced via `errors` below
+                errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=_one, args=(i,), daemon=True)
+            for i in range(n_readers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        if errors or any(s is None for s in spans):
+            return {"ok": False, "errors": errors[:3] or ["reader hung"]}
+        wall = max(s[1] for s in spans) - min(s[0] for s in spans)
+        return {
+            "ok": all(s[2] for s in spans),
+            "wall_s": round(wall, 3),
+            "aggregate_gbps": round(
+                n_readers * actual_payload / 1024**3 / max(wall, 1e-9), 4
+            ),
+        }
+
+    per_n: dict = {}
+    try:
+        for n_readers in reader_counts:
+            # ------------------------------------------------ direct leg
+            shared = _shared_state()
+
+            def _hook(plugin, url, shared=shared):
+                prev = holder["prev"]
+                base = prev(plugin, url) if prev is not None else plugin
+                return _SharedRateReadThrottle(base, shared)
+
+            holder = {"prev": _sp_mod.set_plugin_wrap_hook(_hook)}
+            try:
+                direct = _run_group(n_readers, lambda: Snapshot(root))
+            finally:
+                _sp_mod.set_plugin_wrap_hook(holder["prev"])
+            direct["backend_bytes"] = shared["bytes"]
+            direct["amplification"] = round(
+                shared["bytes"] / actual_payload, 3
+            )
+
+            # ------------------------------------------------ served leg
+            # A FRESH server (cold cache) per group so every N measures
+            # its own amplification; the modeled throttle lives in the
+            # server's backend resolver only — client RPCs must not pay
+            # it (that is the disaggregation being measured).
+            shared_served = _shared_state()
+            service = snapserve.ReadService(
+                backend_resolver=lambda url: _SharedRateReadThrottle(
+                    url_to_storage_plugin(url), shared_served
+                ),
+            )
+            server = snapserve.start_local_server(service=service)
+            fallbacks_before = snapserve.stats_snapshot()[
+                "fallback_objects"
+            ]
+            try:
+                served = _run_group(
+                    n_readers,
+                    lambda: snapserve.RemoteSnapshot(
+                        root, addr=server.addr
+                    ),
+                )
+                stats = service.stats()
+            finally:
+                server.stop()
+            served["backend_bytes"] = shared_served["bytes"]
+            served["amplification"] = round(
+                shared_served["bytes"] / actual_payload, 3
+            )
+            served["cache_hits"] = stats["cache"]["hits"]
+            served["singleflight_collapses"] = stats[
+                "singleflight_collapses"
+            ]
+            # Any fallback means some reads dodged the service — the
+            # amplification number would not be measuring the server.
+            served["fallbacks"] = (
+                snapserve.stats_snapshot()["fallback_objects"]
+                - fallbacks_before
+            )
+            if served["fallbacks"]:
+                served["ok"] = False
+            per_n[str(n_readers)] = {"direct": direct, "served": served}
+
+        top_n = str(max(reader_counts))
+        top = per_n[top_n]
+        amplification_served = top["served"].get("amplification")
+        meets = bool(
+            amplification_served is not None
+            and amplification_served <= 1.2
+        )
+        groups_ok = all(
+            g["direct"].get("ok") and g["served"].get("ok")
+            for g in per_n.values()
+        )
+        return {
+            "ok": bool(groups_ok and meets),
+            "bytes": actual_payload,
+            "modeled_backend_gbps": modeled_backend_gbps,
+            "readers": per_n,
+            "amplification_served": amplification_served,
+            "amplification_direct": top["direct"].get("amplification"),
+            "served_gbps": top["served"].get("aggregate_gbps"),
+            "direct_gbps": top["direct"].get("aggregate_gbps"),
+            "meets_1_2x": meets,
+        }
+    finally:
+        _sp_mod._MEMORY_STORES.pop(
+            root.split("://", 1)[1].split("/", 1)[0], None
+        )
 
 
 def _floor_bytes() -> int:
@@ -1564,6 +1784,32 @@ def _bench_body(bench_dir: str) -> None:
                 _RESULTS["every_step"] = {"ok": False, "error": repr(e)}
         print(
             f"[bench] every_step: {_RESULTS['every_step']}", file=sys.stderr
+        )
+
+        # Read fan-out through the snapserve read plane (ROADMAP item
+        # 3): N in {1, 8, 32} concurrent readers restoring one snapshot
+        # through the service vs direct, behind a shared modeled
+        # object-store egress. Certifies backend-read amplification
+        # <= 1.2x at N=32 (direct pays ~32x). Host-only numpy payloads
+        # — tenancy-independent, fixed small budget like hot_tier.
+        _phase("read fan-out (snapserve)")
+        if _remaining_s() < 75:
+            _RESULTS["read_fanout"] = {
+                "ok": False,
+                "skipped": "deadline",
+                "error": "skipped: hard deadline",
+            }
+            _note_gap(
+                "read_fanout", "remaining budget below the section floor"
+            )
+        else:
+            try:
+                _RESULTS["read_fanout"] = run_read_fanout_block()
+            except Exception as e:
+                _RESULTS["read_fanout"] = {"ok": False, "error": repr(e)}
+        print(
+            f"[bench] read_fanout: {_RESULTS['read_fanout']}",
+            file=sys.stderr,
         )
 
         # Sharded/subdivided write-path coverage (CPU mesh, subprocess):

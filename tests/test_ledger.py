@@ -578,8 +578,14 @@ def test_e2e_timeline_reproduces_trends_and_flags_slow_take(
     for r in takes[1:]:
         assert r["churn"]["efficiency"] == pytest.approx(0.5)
         assert r["goodput"]["goodput_fraction"] is not None
-    # The slow take is visibly slower in the ledger.
-    assert takes[-1]["wall_s"] > 3 * max(r["wall_s"] for r in takes[1:-1])
+    # The slow take is visibly slower in the ledger. Median, not max:
+    # a single ambient fs stall (0.5s+ under full-suite writeback
+    # pressure) on ONE healthy mid take must not mask the injected
+    # slowdown — the sentinel below is the robust detector anyway.
+    import statistics
+
+    mid_walls = [r["wall_s"] for r in takes[1:-1]]
+    assert takes[-1]["wall_s"] > 3 * statistics.median(mid_walls)
 
     # The sentinel names the drifting metric and the first bad step.
     rc = timeline.main([base])

@@ -26,6 +26,11 @@ Compared metrics:
   ack→``.tierdown`` window), LOWER is better
 - ``every_step.hot.overhead_pct`` (every-step checkpointing overhead
   with the tier on, from the goodput accountant), LOWER is better
+- ``read_fanout.amplification_served`` (backend-read amplification for
+  32 concurrent readers through the snapserve read plane — the
+  service's whole point is holding this at ~1x), LOWER is better
+- ``read_fanout.served_gbps`` (aggregate client throughput through the
+  service at the largest fan-out), higher is better
 
 Uncertified numbers (``restore_uncertified``/``degraded``) are compared
 but flagged in the output — a gate wired to flaky numbers should see
@@ -51,6 +56,8 @@ _METRICS: List[Tuple[str, str, str]] = [
     ("hot_tier.hot_vs_durable", "hot/durable ratio", "high"),
     ("hot_tier.durability_lag_s", "durability lag s", "low"),
     ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
+    ("read_fanout.amplification_served", "fanout amplification", "low"),
+    ("read_fanout.served_gbps", "fanout GB/s", "high"),
 ]
 
 
@@ -248,6 +255,29 @@ def _self_test() -> int:
     assert reg and "every-step" in reg[0], f"overhead rise must fail: {reg}"
     _, reg = compare(base, hot, 0.2)
     assert not reg, f"hot-tier keys absent on one side are skipped: {reg}"
+    # Read-fanout keys (snapserve): amplification is lower-is-better —
+    # a creep from ~1x toward per-client backend reads is the
+    # regression; aggregate served throughput is higher-is-better.
+    fanout = dict(
+        base,
+        read_fanout={"amplification_served": 1.0, "served_gbps": 2.0},
+    )
+    _, reg = compare(fanout, dict(fanout), 0.2)
+    assert not reg, f"identical fanout runs must pass: {reg}"
+    worse_amp = dict(
+        fanout,
+        read_fanout={"amplification_served": 1.5, "served_gbps": 2.0},
+    )
+    _, reg = compare(fanout, worse_amp, 0.2)
+    assert reg and "amplification" in reg[0], f"1.5x amp must fail: {reg}"
+    worse_fanout_gbps = dict(
+        fanout,
+        read_fanout={"amplification_served": 1.0, "served_gbps": 1.0},
+    )
+    _, reg = compare(fanout, worse_fanout_gbps, 0.2)
+    assert reg and "fanout GB/s" in reg[0], f"GB/s halving must fail: {reg}"
+    _, reg = compare(base, fanout, 0.2)
+    assert not reg, f"fanout keys absent on one side are skipped: {reg}"
     print("bench_compare self-test OK")
     return 0
 

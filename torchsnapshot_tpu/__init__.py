@@ -18,6 +18,8 @@ from .coord import (
 from .manager import CheckpointManager, PendingManagedSnapshot
 from .rng_state import RNGState
 from .snapshot import PendingSnapshot, Snapshot
+from . import snapserve
+from .snapserve import RemoteSnapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
 from .utils.train_state import FnStateful, PytreeStateful
@@ -35,7 +37,9 @@ __all__ = [
     "PytreeStateful",
     "PendingSnapshot",
     "RNGState",
+    "RemoteSnapshot",
     "Snapshot",
+    "snapserve",
     "StateDict",
     "Stateful",
     "StoreCoordinator",

@@ -29,6 +29,11 @@ Fault kinds:
   op boundary (its RAM replicas vanish; ``hottier.kill_host``); the op
   stream continues and the loss surfaces wherever the tier next touches
   the dead host.
+- **server kill** — every in-process snapserve read-service dies at a
+  deterministic ``snapserve.request`` boundary
+  (``snapserve.kill_local_servers``): sockets abort, the listening
+  port closes, and the client under test must degrade to direct
+  backend reads (counted, bit-exact — the read plane's contract).
 
 The schedule is deterministic by construction: rules fire on the *n*-th
 match of their (op-glob, path-glob) pattern, and the crash point on a
@@ -95,7 +100,7 @@ class FaultRule:
     matching ``(op, path)`` globs (1-based; ``times=None`` = forever)."""
 
     kind: str  # "transient" | "permanent" | "torn" | "latency" | "crash"
-    #          | "hostloss"
+    #          | "hostloss" | "killserver"
     op: str = "*"
     path: str = "*"
     nth: int = 1
@@ -239,6 +244,43 @@ class FaultSchedule:
             times=times,
         )
 
+    def kill_server(
+        self, op: str = "snapserve.request", path: str = "*", nth: int = 1
+    ) -> "FaultSchedule":
+        """Kill every in-process snapserve server at the ``nth`` op
+        matching the globs (default: the ``nth`` client RPC attempt).
+        The boundary fires BEFORE the RPC touches the network, so the
+        matched request itself already finds the server dead — the
+        deterministic mid-restore server-death scenario
+        (docs/FAULTS.md). The op stream continues; the client's
+        degraded direct-read fallback is the behavior under test."""
+        self.rules.append(
+            FaultRule(
+                kind="killserver", op=op, path=path, nth=nth, times=1
+            )
+        )
+        return self
+
+    def slow_server(
+        self,
+        seconds: float = 0.05,
+        path: str = "*",
+        nth: int = 1,
+        times: Optional[int] = None,
+    ) -> "FaultSchedule":
+        """Latency targeting the snapserve client's
+        ``snapserve.request`` boundaries: every matched RPC pays
+        ``seconds`` before dialing — a slow/overloaded read service,
+        without killing it. The deterministic way to stretch a
+        service-routed restore for straggler/SLO assertions."""
+        return self.latency(
+            op="snapserve.request",
+            path=path,
+            seconds=seconds,
+            nth=nth,
+            times=times,
+        )
+
     def crash_at(self, op_index: int) -> "FaultSchedule":
         """Crash at global op index ``op_index`` (1-based) and every
         boundary after it — the crash-point enumerator's lever."""
@@ -341,6 +383,16 @@ class FaultController:
                     from ..hottier import kill_host
 
                     kill_host(rule.host)
+                    continue
+                if rule.kind == "killserver":
+                    self._record(idx, op, path, "killserver")
+                    from ..snapserve.server import kill_local_servers
+
+                    # kill() blocks until the server loop has aborted
+                    # its sockets (never waiting on anything that takes
+                    # this lock), so the very op this boundary guards
+                    # already finds the server dead.
+                    kill_local_servers()
                     continue
                 if rule.kind == "crash":
                     self.crashed = True

@@ -40,7 +40,10 @@ logger = logging.getLogger(__name__)
 # and backends with multi-step durability protocols emit their SUB-step
 # boundaries too (fs.py emits "fs.write.tmp" → "fs.write.fsync" →
 # "fs.write.rename" → "fs.write.dirsync"), so a fault-injection harness can
-# place a crash BETWEEN the steps of a single logical write. A hook may
+# place a crash BETWEEN the steps of a single logical write. The snapserve
+# client announces every read-service RPC attempt as "snapserve.request"
+# BEFORE touching the network, which is where kill_server/slow_server
+# schedules hook in deterministically. A hook may
 # raise — the exception propagates into the op exactly where a real failure
 # (or process death) would strike. Zero cost when no hook is registered
 # (one truthiness check per boundary).

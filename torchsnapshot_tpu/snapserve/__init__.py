@@ -1,0 +1,72 @@
+"""snapserve: disaggregated snapshot read plane (ROADMAP item 3).
+
+The paper's random-access property — one storage object per leaf,
+fetchable in isolation — is wasted if every consumer pays its own
+object-store read. tf.data service (arxiv 2210.14826) makes the
+disaggregation argument for input pipelines: move the shared work into a
+service and N consumers cost ~1x backend work instead of N x. The same
+argument applies verbatim to checkpoint reads: inference replicas
+pulling updated weights, eval jobs, and resharded fine-tune starts all
+read the SAME objects.
+
+Three pieces:
+
+- **Server** (:mod:`.server`) — ``python -m
+  torchsnapshot_tpu.snapserve.server`` (or :func:`start_local_server`
+  in-process): fronts any storage backend with manifest memoization
+  (parse once, serve many), single-flight deduplication (concurrent
+  requests for one object trigger exactly one backend read), range-read
+  coalescing (overlapping chunk reads are served by slicing one
+  whole-object fetch), a byte-capped fingerprint-verified LRU content
+  cache (``TPUSNAPSHOT_SNAPSERVE_CACHE_BYTES``), and per-client flow
+  control with bounded in-flight bytes.
+- **Client plugin** (:mod:`.client`) — the ``snapserve://host:port/
+  <backend-url>`` storage protocol: reads go over the service; writes,
+  deletes, and enumeration go straight to the backend (the read plane
+  never proxies mutations). When the server is unreachable the client
+  degrades to direct backend reads — bit-exact, counted
+  (``tpusnapshot_snapserve_fallbacks_total``), doctor-visible
+  (``read-plane-degraded``), never an error.
+- **RemoteSnapshot** (:mod:`.remote`) — the existing :class:`Snapshot`
+  API (``restore``, ``read_object``, ``get_manifest``, ``verify``)
+  unchanged over the service; the server address comes from the
+  constructor or ``TPUSNAPSHOT_SNAPSERVE_ADDR``.
+
+Fault injection: the client announces every RPC attempt as a
+``snapserve.request`` storage-op boundary, so faultline schedules can
+``kill_server()`` / ``slow_server()`` deterministically mid-restore
+(docs/FAULTS.md).
+"""
+
+from .cache import ByteLRU, content_fingerprint
+from .client import (
+    SnapServePlugin,
+    parse_snapserve_url,
+    restore_stats_begin,
+    restore_stats_collect,
+    stats_snapshot,
+)
+from .remote import RemoteSnapshot
+from .server import (
+    ReadService,
+    SnapServer,
+    fetch_server_stats,
+    kill_local_servers,
+    start_local_server,
+)
+
+__all__ = [
+    "ByteLRU",
+    "ReadService",
+    "RemoteSnapshot",
+    "SnapServePlugin",
+    "SnapServer",
+    "content_fingerprint",
+    "fetch_server_stats",
+    "kill_local_servers",
+    "parse_snapserve_url",
+    "restore_stats_begin",
+    "restore_stats_collect",
+    "start_local_server",
+    "stats_snapshot",
+]

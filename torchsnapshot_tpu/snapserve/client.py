@@ -1,0 +1,433 @@
+"""snapserve client: the ``snapserve://host:port/<backend-url>`` plugin.
+
+Reads go over the read service; writes, deletes, durability settles,
+and enumeration go straight to the backend — the read plane never
+proxies mutations, so a ``RemoteSnapshot`` writing its best-effort
+flight report or appending the ledger behaves byte-identically to a
+direct reader.
+
+Degraded mode is the load-bearing contract: when the server is
+unreachable (dead, partitioned, never started), every read falls back
+to a DIRECT backend read through the normal resolution path (retry
+policy and wrap hooks included) — bit-exact, counted
+(``tpusnapshot_snapserve_fallbacks_total{reason}``), surfaced in the
+restore flight report's ``read_plane`` block, the
+``read-plane-degraded`` doctor rule, and the ledger — never an error.
+After a transport failure the client skips RPC attempts for a short
+cooldown (``TPUSNAPSHOT_SNAPSERVE_DOWN_COOLDOWN_S``) so a dead server
+costs one dial timeout, not one per object.
+
+Every RPC attempt announces a ``snapserve.request`` storage-op boundary
+(:func:`torchsnapshot_tpu.io_types.emit_storage_op`) BEFORE touching
+the network, which is where faultline's ``kill_server`` /
+``slow_server`` schedule rules hook in deterministically.
+"""
+
+import asyncio
+import contextvars
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..io_types import IOReq, StoragePlugin, emit_storage_op, io_payload
+from ..telemetry import metrics as _metric_names
+from ..utils.env import env_float
+from .protocol import (
+    PROTOCOL_VERSION,
+    ProtocolError,
+    recv_frame,
+    send_frame,
+    wire_to_error,
+)
+
+logger = logging.getLogger(__name__)
+
+ADDR_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_ADDR"
+DOWN_COOLDOWN_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_DOWN_COOLDOWN_S"
+_DEFAULT_DOWN_COOLDOWN_S = 5.0
+TIMEOUT_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_TIMEOUT_S"
+_DEFAULT_TIMEOUT_S = 60.0
+_DIAL_TIMEOUT_S = 5.0
+_POOL_MAX_CONNS = 16
+
+# Transport-level failures = "the server is unreachable" = fall back.
+# Anything the server itself reports (not-found, range, backend error)
+# is re-raised as the matching exception — it is the BACKEND speaking,
+# and must behave identically to a direct read. The distinction cannot
+# be made by exception TYPE alone (a remote not-found unmarshals to
+# FileNotFoundError, which is an OSError like every socket failure), so
+# _rpc_read wraps genuine transport failures in _TransportFailure and
+# lets unmarshalled server verdicts fly bare.
+_TRANSPORT_ERRORS = (
+    ConnectionError,
+    asyncio.IncompleteReadError,
+    asyncio.TimeoutError,
+    ProtocolError,
+    OSError,
+)
+
+
+class _TransportFailure(Exception):
+    """The server could not be spoken to (dial/send/recv/framing died).
+    Internal: always caught by ``read()`` and converted to a fallback;
+    ``__cause__`` carries the underlying failure."""
+
+
+def parse_snapserve_url(spec: str) -> Tuple[str, str]:
+    """``"host:port/<backend-url>"`` (the part after ``snapserve://``)
+    → ``(addr, backend_url)``. The backend may itself carry a scheme
+    (``memory://…``, ``gs://…``) or be a bare fs path (leading ``/``)."""
+    addr, sep, backend = spec.partition("/")
+    if not sep or not backend:
+        raise ValueError(
+            f"Malformed snapserve URL {spec!r}: expected "
+            f"snapserve://host:port/<backend-url>"
+        )
+    host, colon, port = addr.rpartition(":")
+    if not colon or not host or not port.isdigit():
+        raise ValueError(
+            f"Malformed snapserve address {addr!r}: expected host:port"
+        )
+    if backend.startswith("snapserve://"):
+        raise ValueError(
+            "snapserve URLs do not nest: the backend of a snapserve URL "
+            "must be a real storage backend"
+        )
+    if "://" not in backend and not backend.startswith("/"):
+        # fs paths written without the leading slash after the addr
+        # ("snapserve://h:p/tmp/x" parses backend "tmp/x") would point
+        # somewhere surprising; require an absolute form.
+        backend = "/" + backend
+    return addr, backend
+
+
+# --------------------------------------------------- client-side read stats
+#
+# Two layers. The module-level totals (stats_snapshot) are the
+# process-lifetime counters tests/bench read. Per-RESTORE attribution —
+# the flight report's read_plane block — is a contextvar-scoped
+# accumulator instead of a delta over the globals: two restores running
+# concurrently in one process (the bench fan-out / CI smoke pattern)
+# must not absorb each other's fallbacks, or the read-plane-degraded
+# rule fires against the wrong restore. The contextvar set in the
+# restoring thread propagates into every asyncio.run() that thread
+# issues (asyncio copies the ambient context), which is exactly where
+# this plugin's reads execute.
+
+_STATS_LOCK = threading.Lock()
+_STATS: Dict[str, Any] = {
+    "remote_objects": 0,
+    "remote_bytes": 0,
+    "fallback_objects": 0,
+    "fallback_bytes": 0,
+    "reasons": {},
+}
+
+_SCOPE: "contextvars.ContextVar[Optional[Dict[str, Any]]]" = (
+    contextvars.ContextVar("snapserve_restore_scope", default=None)
+)
+
+
+def _note_remote(nbytes: int) -> None:
+    with _STATS_LOCK:
+        _STATS["remote_objects"] += 1
+        _STATS["remote_bytes"] += nbytes
+    scope = _SCOPE.get()
+    if scope is not None:
+        with _STATS_LOCK:
+            scope["remote_objects"] += 1
+            scope["remote_bytes"] += nbytes
+
+
+def _note_fallback(nbytes: int, reason: str) -> None:
+    with _STATS_LOCK:
+        _STATS["fallback_objects"] += 1
+        _STATS["fallback_bytes"] += nbytes
+        _STATS["reasons"][reason] = _STATS["reasons"].get(reason, 0) + 1
+    scope = _SCOPE.get()
+    if scope is not None:
+        with _STATS_LOCK:
+            scope["fallback_objects"] += 1
+            scope["fallback_bytes"] += nbytes
+            scope["reasons"][reason] = scope["reasons"].get(reason, 0) + 1
+
+
+def stats_snapshot() -> Dict[str, Any]:
+    """Process-lifetime client totals (all operations, all threads)."""
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        out["reasons"] = dict(_STATS["reasons"])
+        return out
+
+
+def restore_stats_begin() -> Any:
+    """Open a per-restore read-plane attribution scope (cheap; whether
+    any snapserve traffic happens is only known at collect time)."""
+    scope = {
+        "remote_objects": 0,
+        "remote_bytes": 0,
+        "fallback_objects": 0,
+        "fallback_bytes": 0,
+        "reasons": {},
+    }
+    return scope, _SCOPE.set(scope)
+
+
+def restore_stats_collect(token: Any) -> Optional[Dict[str, Any]]:
+    """Close the scope opened by :func:`restore_stats_begin` and return
+    its ``read_plane`` block: remote vs fallback object/byte counts and
+    fallback reasons — THIS restore's traffic only, regardless of what
+    other threads did meanwhile. None when the operation saw no
+    snapserve traffic at all (direct snapshots)."""
+    if token is None:
+        return None
+    scope, var_token = token
+    try:
+        _SCOPE.reset(var_token)
+    except ValueError:
+        # Reset from a different context than set (defensive; collect
+        # runs in the same thread as begin in practice).
+        logger.warning("read-plane scope reset crossed contexts")
+    with _STATS_LOCK:
+        summary = {
+            "remote_objects": scope["remote_objects"],
+            "remote_bytes": scope["remote_bytes"],
+            "fallback_objects": scope["fallback_objects"],
+            "fallback_bytes": scope["fallback_bytes"],
+        }
+        reasons = dict(scope["reasons"])
+    if not any(summary.values()):
+        return None
+    if reasons:
+        summary["fallback_reasons"] = reasons
+    return summary
+
+
+class SnapServePlugin(StoragePlugin):
+    """Storage plugin speaking to a snapserve server, with direct
+    backend fallback. Resolved by ``url_to_storage_plugin`` for
+    ``snapserve://`` URLs (and then wrapped in the normal retry layer,
+    so transient SERVER-SIDE backend failures retry like direct ones)."""
+
+    def __init__(self, spec: str) -> None:
+        self._addr_str, self._backend_url = parse_snapserve_url(spec)
+        host, _, port = self._addr_str.rpartition(":")
+        self._addr = (host, int(port))
+        self._direct: Optional[StoragePlugin] = None
+        # Connection pools are per event loop: Snapshot runs each
+        # operation under its own asyncio.run(), and a socket created
+        # on a dead loop cannot be awaited from a new one. Entries hold
+        # the LOOP OBJECT alongside the conns and check identity on
+        # lookup — keying by id() alone could hand a freshly-allocated
+        # loop a dead loop's sockets when CPython recycles the address.
+        self._pools: Dict[int, Tuple[Any, List[Tuple[Any, Any]]]] = {}
+        self._lock = threading.Lock()
+        self._down_until = 0.0
+        self._request_id = 0
+        self.max_write_concurrency = 16
+        self.max_read_concurrency = 16
+
+    # ------------------------------------------------------------- plumbing
+
+    def _direct_plugin(self) -> StoragePlugin:
+        """The direct backend plugin (fallback reads + ALL mutations),
+        resolved through the normal path so retries and wrap hooks
+        apply exactly as they would for a non-snapserve reader."""
+        with self._lock:
+            plugin = self._direct
+        if plugin is not None:
+            return plugin
+        from ..storage_plugin import url_to_storage_plugin
+
+        plugin = url_to_storage_plugin(self._backend_url)
+        with self._lock:
+            if self._direct is None:
+                self._direct = plugin
+                return plugin
+            keep = self._direct
+        try:
+            plugin.close()
+        except Exception:
+            logger.warning(
+                "snapserve duplicate direct plugin close failed",
+                exc_info=True,
+            )
+        return keep
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._request_id += 1
+            return self._request_id
+
+    def _pool(self) -> List[Tuple[Any, Any]]:
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            entry = self._pools.get(id(loop))
+            if entry is None or entry[0] is not loop:
+                stale = entry[1] if entry is not None else []
+                entry = (loop, [])
+                self._pools[id(loop)] = entry
+            else:
+                stale = []
+        for _reader, writer in stale:
+            try:
+                writer.transport.abort()
+            except Exception:
+                logger.debug(
+                    "snapserve stale pooled conn abort failed",
+                    exc_info=True,
+                )
+        return entry[1]
+
+    async def _checkout(self) -> Tuple[Any, Any]:
+        pool = self._pool()
+        with self._lock:
+            if pool:
+                return pool.pop()
+        return await asyncio.wait_for(
+            asyncio.open_connection(*self._addr), _DIAL_TIMEOUT_S
+        )
+
+    def _checkin(self, conn: Tuple[Any, Any]) -> None:
+        pool = self._pool()
+        with self._lock:
+            if len(pool) < _POOL_MAX_CONNS:
+                pool.append(conn)
+                return
+        try:
+            conn[1].close()
+        except Exception:
+            logger.debug("snapserve pool overflow close failed", exc_info=True)
+
+    def _mark_down(self) -> None:
+        cooldown = env_float(
+            DOWN_COOLDOWN_ENV_VAR, _DEFAULT_DOWN_COOLDOWN_S
+        )
+        with self._lock:
+            self._down_until = time.monotonic() + cooldown
+
+    def _is_down(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._down_until
+
+    # ------------------------------------------------------------------ RPC
+
+    async def _rpc_read(
+        self, path: str, byte_range: Optional[tuple]
+    ) -> bytes:
+        timeout_s = env_float(TIMEOUT_ENV_VAR, _DEFAULT_TIMEOUT_S)
+        try:
+            conn = await self._checkout()
+        except _TRANSPORT_ERRORS as e:
+            raise _TransportFailure(f"dial {self._addr_str}: {e!r}") from e
+        reader, writer = conn
+        try:
+            await send_frame(
+                writer,
+                {
+                    "v": PROTOCOL_VERSION,
+                    "op": "read",
+                    "id": self._next_id(),
+                    "backend": self._backend_url,
+                    "path": path,
+                    "range": list(byte_range) if byte_range else None,
+                },
+            )
+            header, payload = await asyncio.wait_for(
+                recv_frame(reader), timeout_s
+            )
+        except BaseException as e:
+            try:
+                writer.transport.abort()
+            except Exception:
+                logger.debug(
+                    "snapserve conn abort failed", exc_info=True
+                )
+            if isinstance(e, _TRANSPORT_ERRORS):
+                raise _TransportFailure(
+                    f"rpc to {self._addr_str}: {e!r}"
+                ) from e
+            raise
+        self._checkin(conn)
+        if not header.get("ok"):
+            # The SERVER answered: this is the backend's verdict
+            # (not-found / range / backend failure), not unreachability
+            # — it propagates exactly as a direct read would raise it.
+            raise wire_to_error(header.get("error"), path)
+        return payload
+
+    # ---------------------------------------------------------------- reads
+
+    async def read(self, io_req: IOReq) -> None:
+        emit_storage_op("snapserve.request", io_req.path)
+        if self._is_down():
+            await self._fallback_read(io_req, reason="down")
+            return
+        try:
+            payload = await self._rpc_read(io_req.path, io_req.byte_range)
+        except _TransportFailure as e:
+            logger.warning(
+                f"snapserve: server {self._addr_str} unreachable for "
+                f"read({io_req.path}): {e.__cause__!r}; degrading to "
+                f"direct backend reads"
+            )
+            self._mark_down()
+            await self._fallback_read(io_req, reason="unreachable")
+            return
+        io_req.data = payload
+        _note_remote(len(payload))
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REMOTE_READS, result="served"
+        ).inc()
+
+    async def _fallback_read(self, io_req: IOReq, reason: str) -> None:
+        telemetry.counter(
+            _metric_names.SNAPSERVE_FALLBACKS, reason=reason
+        ).inc()
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REMOTE_READS, result="fallback"
+        ).inc()
+        await self._direct_plugin().read(io_req)
+        _note_fallback(len(io_payload(io_req)), reason)
+
+    # ------------------------------------------------- mutations: direct only
+
+    async def write(self, io_req: IOReq) -> None:
+        await self._direct_plugin().write(io_req)
+
+    async def delete(self, path: str) -> None:
+        await self._direct_plugin().delete(path)
+
+    async def list_prefix(self, prefix: str):
+        return await self._direct_plugin().list_prefix(prefix)
+
+    async def object_age_s(self, path: str) -> Optional[float]:
+        return await self._direct_plugin().object_age_s(path)
+
+    async def object_size_bytes(self, path: str) -> Optional[int]:
+        return await self._direct_plugin().object_size_bytes(path)
+
+    def ensure_durable(self) -> None:
+        with self._lock:
+            plugin = self._direct
+        if plugin is not None:
+            plugin.ensure_durable()
+
+    def close(self) -> None:
+        with self._lock:
+            pools = list(self._pools.values())
+            self._pools.clear()
+            direct = self._direct
+            self._direct = None
+        for _loop, pool in pools:
+            for _reader, writer in pool:
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    logger.debug(
+                        "snapserve pooled conn close failed", exc_info=True
+                    )
+        if direct is not None:
+            direct.close()

@@ -127,6 +127,11 @@ class Snapshot:
         self.path = path
         self._coord = coord
         self._metadata_cache: Optional[SnapshotMetadata] = None
+        # Derived-view memo: get_available_entries() walks and re-keys
+        # the whole manifest — per read_object call that dominated the
+        # "fetch one weight" path on large manifests. Keyed by rank;
+        # invalidated with the metadata cache (delete / re-fetch).
+        self._available_cache: Dict[int, Manifest] = {}
 
     # ------------------------------------------------------------------ take
 
@@ -672,7 +677,7 @@ class Snapshot:
     ):
         # The restore() wrapper owns the storage plugin's lifetime.
         metadata = self._read_snapshot_metadata(storage)
-        available = get_available_entries(metadata.manifest, rank)
+        available = self._available_entries(metadata, rank)
 
         # Rank-local flight record: the read/consume/assemble breakdown
         # that names a consume-dominated restore (BENCH_r05) from a file
@@ -698,6 +703,14 @@ class Snapshot:
         from . import hottier as _hottier
 
         tier_token = _hottier.restore_stats_begin()
+        # Read-plane attribution (snapserve/): which objects were served
+        # by the read service vs fell back to direct backend reads —
+        # the flight report's ``read_plane`` block, read by the
+        # ``read-plane-degraded`` doctor rule and the ledger. None
+        # whenever the restore saw no snapserve traffic.
+        from .snapserve import client as _snapserve_client
+
+        read_plane_token = _snapserve_client.restore_stats_begin()
 
         app_state = dict(app_state)
         rng_key, rng_stateful = _pop_rng_state(app_state)
@@ -746,6 +759,11 @@ class Snapshot:
         tier_summary = _hottier.restore_stats_collect(tier_token)
         if tier_summary is not None:
             recorder.note(tier=tier_summary)
+        read_plane_summary = _snapserve_client.restore_stats_collect(
+            read_plane_token
+        )
+        if read_plane_summary is not None:
+            recorder.note(read_plane=read_plane_summary)
         self._finish_restore_report(
             recorder, read_stats, storage, rank, coordinator
         )
@@ -1067,6 +1085,10 @@ class Snapshot:
                     asyncio.run(_gc_backlinks_in_bases(metadata, self.path))
                 except Exception as e:
                     logger.warning(f"back-link marker GC failed: {e!r}")
+            # The handle must not keep serving the deleted snapshot's
+            # manifest from its memo: a later read_object/restore must
+            # see storage truth (not-found, or a re-taken snapshot).
+            self.invalidate_caches()
         finally:
             storage.close()
 
@@ -1500,7 +1522,7 @@ class Snapshot:
         storage = self._open_storage()
         try:
             metadata = self._read_snapshot_metadata(storage)
-            available = get_available_entries(metadata.manifest, rank)
+            available = self._available_entries(metadata, rank)
             if logical_path not in available:
                 known = [
                     p for p in sorted(available)
@@ -1598,6 +1620,24 @@ class Snapshot:
         a ref pay nothing."""
         return RefRouterPlugin(url_to_storage_plugin(self.path))
 
+    def _available_entries(self, metadata: SnapshotMetadata, rank: int) -> Manifest:
+        """Memoized ``get_available_entries`` — repeated ``read_object``
+        calls on one handle re-derive nothing (the manifest itself is
+        already memoized by :meth:`_read_snapshot_metadata`)."""
+        available = self._available_cache.get(rank)
+        if available is None:
+            available = get_available_entries(metadata.manifest, rank)
+            self._available_cache[rank] = available
+        return available
+
+    def invalidate_caches(self) -> None:
+        """Drop the memoized metadata + derived views, forcing the next
+        operation to re-read storage. Called by :meth:`delete`; call it
+        explicitly after re-taking over this handle's path from
+        elsewhere (a NEW handle needs no invalidation)."""
+        self._metadata_cache = None
+        self._available_cache = {}
+
     def _read_snapshot_metadata(self, storage: StoragePlugin) -> SnapshotMetadata:
         if self._metadata_cache is None:
             io_req = IOReq(path=SNAPSHOT_METADATA_FNAME)
@@ -1606,6 +1646,8 @@ class Snapshot:
                 _decode_metadata_doc(bytes(io_payload(io_req)))
             )
             self._metadata_cache = _decorate_metadata_refs(metadata)
+            # Derived views belong to the PREVIOUS metadata document.
+            self._available_cache = {}
         metadata = self._metadata_cache
         if metadata.base_paths and isinstance(storage, RefRouterPlugin):
             # Attach per-storage-instance (the cache outlives any one

@@ -2,7 +2,9 @@
 
 TPU-native analog of reference torchsnapshot/storage_plugin.py:16-60.
 Protocols: ``fs`` (default when no ``://`` present), ``memory``, ``gs``,
-``s3``; unknown protocols resolve through the ``storage_plugins`` Python
+``s3``, ``snapserve`` (the read-plane client,
+``snapserve://host:port/<backend-url>``); unknown protocols resolve
+through the ``storage_plugins`` Python
 entry-point group so third-party backends can register themselves
 (reference storage_plugin.py:43-58).
 
@@ -76,6 +78,14 @@ def _resolve_plugin(url_path: str) -> StoragePlugin:
         bucket, _, prefix = path.partition("/")
         store = _MEMORY_STORES.setdefault(bucket, {})
         return MemoryStoragePlugin(store=store, prefix=prefix)
+    if protocol == "snapserve":
+        # Disaggregated read plane (snapserve/): reads go through the
+        # caching read service at host:port, everything else straight
+        # to the embedded backend URL; unreachable servers degrade to
+        # direct backend reads (counted, never an error).
+        from .snapserve.client import SnapServePlugin
+
+        return SnapServePlugin(path)
     if protocol == "gs":
         from .storage_plugins.gcs import GCSStoragePlugin
 

@@ -33,6 +33,10 @@ above-budget              TPUSNAPSHOT_CKPT_BUDGET_PCT (default 5%)
 missing-rank-summary      a rank's summary never arrived (null)
 hot-tier-degraded         a restore fell back to the durable tier for
                           >0 objects (critical when >50% of bytes)
+read-plane-degraded       a restore routed via snapserve fell back to
+                          direct backend reads for >0 objects
+                          (critical when >50% of bytes) — the read
+                          service was unreachable; bit-exactness held
 durability-lag-above-     the take's ack→.tierdown window (stamped into
 budget                    the report by the hot tier's drain) exceeded
                           TPUSNAPSHOT_SLO_DURABILITY_LAG_S (default
@@ -512,6 +516,65 @@ def _rule_hot_tier_degraded(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_read_plane_degraded(report: Dict[str, Any]) -> Optional[Finding]:
+    """A restore routed through the snapserve read plane leaked reads
+    to direct backend access: >0 fallbacks fire a warning (the restore
+    stayed bit-exact — that is the fallback's contract — but every
+    fallback re-pays the backend read the service exists to
+    deduplicate), and a majority of the BYTES falling back (the server
+    effectively absent) is critical. Reasons: 'unreachable' = a dial or
+    transport failure on that very read; 'down' = inside the
+    post-failure cooldown window (the server was seen dead moments
+    before)."""
+    if report.get("kind") != "restore":
+        return None
+    planes = [
+        s.get("read_plane") for s in _ranks(report) if s.get("read_plane")
+    ]
+    if not planes:
+        return None
+    fallback_objects = sum(
+        int(p.get("fallback_objects") or 0) for p in planes
+    )
+    if fallback_objects <= 0:
+        return None
+    fallback_bytes = sum(int(p.get("fallback_bytes") or 0) for p in planes)
+    remote_bytes = sum(int(p.get("remote_bytes") or 0) for p in planes)
+    total_bytes = remote_bytes + fallback_bytes
+    fraction = fallback_bytes / total_bytes if total_bytes > 0 else 1.0
+    reasons: Dict[str, int] = {}
+    for p in planes:
+        for r, c in (p.get("fallback_reasons") or {}).items():
+            reasons[r] = reasons.get(r, 0) + int(c)
+    return Finding(
+        rule="read-plane-degraded",
+        severity="critical" if fraction > 0.5 else "warn",
+        title=(
+            f"restore fell back to direct backend reads for "
+            f"{fallback_objects} object(s) "
+            f"({100 * fraction:.0f}% of bytes) — the snapserve read "
+            f"plane was unreachable"
+        ),
+        evidence={
+            "fallback_objects": fallback_objects,
+            "fallback_bytes": fallback_bytes,
+            "remote_bytes": remote_bytes,
+            "fallback_byte_fraction": round(fraction, 3),
+            "reasons": reasons,
+        },
+        remediation=(
+            "the restore stayed bit-exact (direct fallback is the "
+            "degraded-mode contract), but each falling-back client "
+            "re-pays backend reads the service would have "
+            "deduplicated — at fleet fan-out that multiplies "
+            "object-store egress. Check the snapserve server process "
+            "and TPUSNAPSHOT_SNAPSERVE_ADDR routing; restart the "
+            "server and clients reattach automatically on their next "
+            "read (after the cooldown window)."
+        ),
+    )
+
+
 RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_consume_dominated,
     _rule_read_dominated,
@@ -524,6 +587,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_durability_lag,
     _rule_missing_summary,
     _rule_hot_tier_degraded,
+    _rule_read_plane_degraded,
 ]
 
 _SEVERITY_ORDER = {"critical": 0, "warn": 1}

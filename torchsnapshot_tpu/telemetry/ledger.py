@@ -72,6 +72,13 @@ source operation did not produce them::
                "fallback_bytes", "degraded_peers": [host, ...]} | null,
                                          # hot-tier attribution (restores
                                          # with the hot tier enabled)
+      "read_plane": {"remote_objects", "remote_bytes",
+                     "fallback_objects", "fallback_bytes",
+                     "fallback_reasons": {reason: n}} | null,
+                                         # snapserve attribution
+                                         # (restores routed through the
+                                         # read service; fallbacks =
+                                         # direct degraded reads)
       "durability_lag_s": null,          # ALWAYS null on take records —
                                          # the digest is written at commit,
                                          # while the ack→.tierdown window
@@ -513,6 +520,38 @@ def _tier_totals(
     }
 
 
+def _read_plane_totals(
+    summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank snapserve ``read_plane`` blocks into the
+    digest's ``read_plane`` field. None when no rank saw read-plane
+    traffic (direct snapshots, or a take — only restores read)."""
+    noted = [
+        s.get("read_plane") for s in summaries if s and s.get("read_plane")
+    ]
+    if not noted:
+        return None
+    reasons: Dict[str, int] = {}
+    for p in noted:
+        for r, c in (p.get("fallback_reasons") or {}).items():
+            reasons[r] = reasons.get(r, 0) + int(c)
+    out = {
+        "remote_objects": sum(
+            int(p.get("remote_objects") or 0) for p in noted
+        ),
+        "remote_bytes": sum(int(p.get("remote_bytes") or 0) for p in noted),
+        "fallback_objects": sum(
+            int(p.get("fallback_objects") or 0) for p in noted
+        ),
+        "fallback_bytes": sum(
+            int(p.get("fallback_bytes") or 0) for p in noted
+        ),
+    }
+    if reasons:
+        out["fallback_reasons"] = reasons
+    return out
+
+
 def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
     """Fold a merged flight report (take or restore) into one ledger
     record. Runs the doctor over the report so the record carries the
@@ -558,6 +597,7 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "goodput": goodput,
         "churn": _churn_totals(summaries, nbytes),
         "tier": _tier_totals(summaries),
+        "read_plane": _read_plane_totals(summaries),
         # Null by construction at commit time (see the schema note);
         # the hot tier's drain appends a `tierdown` event record that
         # carries the closed window.

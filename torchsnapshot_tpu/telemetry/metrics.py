@@ -383,3 +383,38 @@ SCHED_BUDGET_STALLED = (
 # loop errors swallowed (the crash-isolation contract made visible).
 SAMPLER_SAMPLES = "tpusnapshot_sampler_samples_total"  # counter
 SAMPLER_ERRORS = "tpusnapshot_sampler_errors_total"  # counter
+# Read plane (snapserve/). Server side: request counts by op, content-
+# cache events (hit/miss/corrupt/eviction), single-flight collapses
+# (requests that piggybacked on another request's backend read),
+# manifest-memo hits vs loads, backend ingress vs client egress bytes
+# (their ratio is the read-amplification the service exists to kill),
+# connected clients, and flow-control stall seconds. Client side:
+# remote reads served vs direct-backend fallbacks by reason
+# (unreachable — dial/transport failed; down — inside the post-failure
+# cooldown window). All label sets bounded.
+SNAPSERVE_REQUESTS = "tpusnapshot_snapserve_requests_total"  # counter {op}
+SNAPSERVE_CACHE_EVENTS = (
+    "tpusnapshot_snapserve_cache_events_total"  # counter {event}
+)
+SNAPSERVE_SINGLEFLIGHT_COLLAPSES = (
+    "tpusnapshot_snapserve_singleflight_collapses_total"  # counter
+)
+SNAPSERVE_MANIFEST_MEMO = (
+    "tpusnapshot_snapserve_manifest_memo_total"  # counter {event}
+)
+SNAPSERVE_BACKEND_READ_BYTES = (
+    "tpusnapshot_snapserve_backend_read_bytes_total"  # counter
+)
+SNAPSERVE_EGRESS_BYTES = (
+    "tpusnapshot_snapserve_egress_bytes_total"  # counter
+)
+SNAPSERVE_CLIENTS = "tpusnapshot_snapserve_connected_clients"  # gauge
+SNAPSERVE_FLOW_STALL_SECONDS = (
+    "tpusnapshot_snapserve_flow_control_stall_seconds_total"  # counter
+)
+SNAPSERVE_REMOTE_READS = (
+    "tpusnapshot_snapserve_remote_reads_total"  # counter {result}
+)
+SNAPSERVE_FALLBACKS = (
+    "tpusnapshot_snapserve_fallbacks_total"  # counter {reason}
+)

@@ -78,6 +78,18 @@ _BENCH_METRICS: List[_MetricDef] = [
     ("hot_tier.hot_vs_durable", "hot/durable restore ratio", "low", 0.5, 0.3),
     ("hot_tier.durability_lag_s", "bench durability lag s", "high", 0.5, None),
     ("every_step.hot.overhead_pct", "every-step overhead %", "high", 0.5, 0.3),
+    # PR 9 snapserve read-fanout headline numbers: backend-read
+    # amplification at 32 concurrent clients (the service must hold it
+    # near 1x — creep back toward per-client backend reads is THE
+    # read-plane regression) and the aggregate served throughput.
+    (
+        "read_fanout.amplification_served",
+        "read-fanout amplification",
+        "high",
+        0.1,
+        0.15,
+    ),
+    ("read_fanout.served_gbps", "read-fanout GB/s", "low", 0.05, 0.3),
 ]
 
 
