@@ -138,6 +138,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 from torchsnapshot_tpu import Snapshot  # noqa: E402
 from torchsnapshot_tpu.models.ddp_synthetic import SyntheticModel  # noqa: E402
@@ -241,6 +242,7 @@ def _summary_doc() -> dict:
         "doctor_findings": r.get("doctor_findings"),
         "step_stall": r.get("step_stall"),
         "incremental": r.get("incremental"),
+        "dedup_codec": r.get("dedup_codec"),
         "hot_tier": r.get("hot_tier"),
         "every_step": r.get("every_step"),
         "read_fanout": r.get("read_fanout"),
@@ -554,6 +556,236 @@ def _run_incremental_block(
         "speedup": round(full_s / max(inc_s, 1e-9), 2),
         "reduced": reduced,
     }
+
+
+def run_dedup_codec_block(
+    bench_dir: str, d2h_gbps: float = None, reduced: bool = False
+) -> dict:
+    """Content-addressed chunk-store headline (chunkstore.py): an
+    unchanged-majority workload taken three times through the chunk
+    store, certifying
+
+    (a) a second take of an UNCHANGED model persists < 5% of its
+        logical bytes (cross-take dedup);
+    (b) a take after dirtying 10% of one large leaf's rows persists
+        < 20% of THAT LEAF's logical bytes (sub-leaf dedup — the case
+        leaf-granular ``base=`` takes cannot touch);
+    (c) lossless codecs restore bit-exact, the opt-in int8 codec
+        restores within its documented tolerance
+        (codecs.quant_error_bound) and never reaches a non-opted leaf;
+    (d) EFFECTIVE take throughput (logical bytes / wall) on the
+        unchanged retake exceeds the adjacent D2H probe ceiling — the
+        first bench number allowed to beat the hardware bound, because
+        unchanged bytes never cross the link at all.
+
+    ``reduced=True`` shrinks the payload for tight budgets / CI smokes
+    and skips the ceiling assertion (commit overhead dominates a toy
+    payload; the dedup/codec structure being certified is size-
+    independent)."""
+    import glob as _glob
+
+    from torchsnapshot_tpu import codecs as _codecs
+
+    run = f"{bench_dir}/dedup-run"
+    shutil.rmtree(run, ignore_errors=True)
+    os.makedirs(run, exist_ok=True)
+    n_params, param_bytes, emb_bytes = 8, 32 << 20, 64 << 20
+    if reduced:
+        n_params, param_bytes, emb_bytes = 4, 4 << 20, 8 << 20
+    chunk_bytes = 1 << 20
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("TPUSNAPSHOT_CHUNK_BYTES", "TPUSNAPSHOT_CHUNK_MIN_BYTES")
+    }
+    os.environ["TPUSNAPSHOT_CHUNK_BYTES"] = str(chunk_bytes)
+    os.environ["TPUSNAPSHOT_CHUNK_MIN_BYTES"] = str(1 << 16)
+    lossless = _codecs.best_lossless()
+    codec_spec = {"opt/*": "int8", "*": lossless}
+
+    def _store_bytes() -> int:
+        return sum(
+            os.path.getsize(p)
+            for p in _glob.glob(f"{run}/.chunkstore/objects/*/*")
+        )
+
+    try:
+        model = SyntheticModel(
+            n_params=n_params, param_bytes=param_bytes, seed=41
+        )
+        cols = 1024
+        rows = emb_bytes // (cols * 4)
+        model.params["embedding"] = jax.random.normal(
+            jax.random.key(7), (rows, cols), dtype=jnp.float32
+        )
+        opt = SyntheticModel(n_params=2, param_bytes=param_bytes, seed=43)
+        state = {"model": model, "opt": opt}
+        logical = model.total_bytes() + opt.total_bytes()
+        jax.block_until_ready(
+            list(model.params.values()) + list(opt.params.values())
+        )
+
+        # Cold take: every chunk misses; also warms the chunked-
+        # fingerprint kernel compiles for these shapes.
+        t0 = time.monotonic()
+        Snapshot.take(f"{run}/step-1", state, chunks=True, codec=codec_spec)
+        cold_s = time.monotonic() - t0
+        cold_physical = _store_bytes()
+        codec_ratio = cold_physical / logical
+
+        # Unchanged retake, bracketed by an adjacent D2H probe so the
+        # effective-throughput ratio pairs the same tenancy moment.
+        probe = (
+            d2h_gbps
+            if d2h_gbps is not None
+            else (_probe_d2h_gbps() if not reduced else None)
+        )
+        t0 = time.monotonic()
+        Snapshot.take(f"{run}/step-2", state, chunks=True, codec=codec_spec)
+        second_s = time.monotonic() - t0
+        second_physical = _store_bytes() - cold_physical
+        second_pct = 100.0 * second_physical / logical
+        effective_gbps = logical / 1024**3 / max(second_s, 1e-9)
+        effective_vs_ceiling = (
+            effective_gbps / probe if probe else None
+        )
+
+        # Dirty 10% of the embedding's rows (a contiguous trained-row
+        # region) — the sub-leaf case leaf dedup cannot touch.
+        emb = np.asarray(model.params["embedding"]).copy()
+        dirty_rows = max(1, rows // 10)
+        emb[:dirty_rows] += 0.125
+        model.params["embedding"] = jnp.asarray(emb)
+        before3 = _store_bytes()
+        t0 = time.monotonic()
+        s3 = Snapshot.take(
+            f"{run}/step-3", state, chunks=True, codec=codec_spec
+        )
+        dirty_s = time.monotonic() - t0
+        dirty_physical = _store_bytes() - before3
+        dirty10_pct = 100.0 * dirty_physical / emb.nbytes
+        dirty_take_pct = 100.0 * dirty_physical / logical
+
+        # Codec correctness on the newest take: lossless leaves
+        # bit-exact, quantized leaves within the documented bound and
+        # NEVER outside the opted-in glob.
+        target_model = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        target_model.params = {
+            k: jnp.zeros_like(v) for k, v in model.params.items()
+        }
+        target_opt = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        target_opt.params = {
+            k: jnp.zeros_like(v) for k, v in opt.params.items()
+        }
+        s3.restore({"model": target_model, "opt": target_opt})
+        lossless_exact = all(
+            np.array_equal(
+                np.asarray(target_model.params[k]),
+                np.asarray(model.params[k]),
+            )
+            for k in model.params
+        )
+        quant_errs = []
+        quant_bounds = []
+        for k, v in opt.params.items():
+            host = np.asarray(v)
+            quant_errs.append(
+                float(
+                    np.abs(np.asarray(target_opt.params[k]) - host).max()
+                )
+            )
+            quant_bounds.append(_codecs.quant_error_bound(host))
+        quant_max_err = max(quant_errs)
+        quant_bound = max(quant_bounds)
+        quant_ok = all(
+            e <= b for e, b in zip(quant_errs, quant_bounds)
+        ) and quant_max_err > 0.0
+        manifest = s3.get_manifest()
+        opt_codecs, other_codecs = set(), set()
+        for path, entry in manifest.items():
+            recs = getattr(entry, "chunks", None)
+            for shard in getattr(entry, "shards", []) or []:
+                if shard.array.chunks:
+                    (opt_codecs if "/opt/" in f"/{path}" else other_codecs).update(
+                        r.get("c") for r in shard.array.chunks
+                    )
+            if recs:
+                (opt_codecs if "/opt/" in f"/{path}" else other_codecs).update(
+                    r.get("c") for r in recs
+                )
+        quant_scoped = "int8" not in other_codecs and (
+            opt_codecs == {"int8"}
+        )
+
+        # Identity-codec leg: its own tiny run (codecs change chunk
+        # KEYS, so mixing codecs inside one run would break the dedup
+        # measurement above).
+        ident_run = f"{bench_dir}/dedup-ident"
+        shutil.rmtree(ident_run, ignore_errors=True)
+        os.makedirs(ident_run, exist_ok=True)
+        ident = SyntheticModel(n_params=2, param_bytes=1 << 20, seed=47)
+        si = Snapshot.take(
+            f"{ident_run}/step-1", {"model": ident}, chunks=True, codec=None
+        )
+        ti = SyntheticModel(n_params=1, param_bytes=1 << 20)
+        ti.params = {k: jnp.zeros_like(v) for k, v in ident.params.items()}
+        si.restore({"model": ti})
+        identity_exact = all(
+            np.array_equal(np.asarray(ti.params[k]), np.asarray(v))
+            for k, v in ident.params.items()
+        )
+        shutil.rmtree(ident_run, ignore_errors=True)
+
+        ok = (
+            second_pct < 5.0
+            and dirty10_pct < 20.0
+            and lossless_exact
+            and identity_exact
+            and quant_ok
+            and quant_scoped
+            and (
+                reduced
+                or effective_vs_ceiling is None
+                or effective_vs_ceiling > 1.0
+            )
+        )
+        return {
+            "ok": bool(ok),
+            "reduced": reduced,
+            "chunk_bytes": chunk_bytes,
+            "codec": lossless,
+            "zstd_available": "zstd" in _codecs.available_codecs(),
+            "logical_bytes": int(logical),
+            "cold_take_s": round(cold_s, 3),
+            "cold_physical_bytes": int(cold_physical),
+            "codec_ratio": round(codec_ratio, 4),
+            "second_take_s": round(second_s, 3),
+            "second_take_physical_bytes": int(second_physical),
+            "second_take_physical_pct": round(second_pct, 3),
+            "effective_gbps": round(effective_gbps, 4),
+            "d2h_ceiling_GBps": round(probe, 4) if probe else None,
+            "effective_vs_ceiling": (
+                round(effective_vs_ceiling, 3)
+                if effective_vs_ceiling is not None
+                else None
+            ),
+            "dirty_take_s": round(dirty_s, 3),
+            "dirty10_physical_pct": round(dirty10_pct, 3),
+            "dirty10_take_physical_pct": round(dirty_take_pct, 3),
+            "dirty_rows_fraction": round(dirty_rows / rows, 4),
+            "lossless_bit_exact": bool(lossless_exact),
+            "identity_bit_exact": bool(identity_exact),
+            "quant_max_err": round(quant_max_err, 6),
+            "quant_bound": round(quant_bound, 6),
+            "quant_within_tolerance": bool(quant_ok),
+            "quant_never_outside_opt_in": bool(quant_scoped),
+        }
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(run, ignore_errors=True)
 
 
 def _modeled_remote(gbps: float):
@@ -1513,8 +1745,18 @@ def _bench_body(bench_dir: str) -> None:
         # so the ratio reflects steady-state throughput); else its own
         # floor; shrunk hard when the takes already overran (degraded
         # tenancy — H2D is the slower direction).
-        remaining_for_restore_s = total_budget_s - (
-            time.monotonic() - bench_start
+        # Reserve wall-clock for the post-restore sections UP FRONT
+        # (BENCH_r04/r05: the restore-certification payload ate the
+        # budget and incremental/step_stall ended "skipped: hard
+        # deadline" — a degraded round with the dedup headline
+        # missing). The restore sizes itself against what remains
+        # AFTER the reservation, shrinking its own payload rather than
+        # starving the sections behind it.
+        _LATE_SECTIONS_RESERVE_S = 330.0
+        remaining_for_restore_s = (
+            total_budget_s
+            - (time.monotonic() - bench_start)
+            - _LATE_SECTIONS_RESERVE_S
         )
         full_restore_est_s = (
             total_bytes / 1024**3 / max(min(probes), 1e-6) + 30.0
@@ -1716,8 +1958,10 @@ def _bench_body(bench_dir: str) -> None:
         # section DEGRADES its payload inside what remains rather than
         # skipping outright (BENCH_r05), and only a budget that cannot
         # carry even the 10 MiB floor records a gap.
-        inc_budget_s = _remaining_s() - 120.0
-        if _remaining_s() >= max(150.0, 2.2 * inc_est_s + 90.0):
+        # Reserve headroom for dedup_codec + hot-tier + stall sections
+        # behind this one (the old 120 s reserve predates dedup_codec).
+        inc_budget_s = _remaining_s() - 180.0
+        if _remaining_s() >= max(210.0, 2.2 * inc_est_s + 150.0):
             inc_budget_s = None  # full budget: no reduction needed
         if inc_budget_s is not None and (
             inc_budget_s < 30.0
@@ -1743,6 +1987,36 @@ def _bench_body(bench_dir: str) -> None:
                 _RESULTS["incremental"] = {"ok": False, "error": repr(e)}
         print(
             f"[bench] incremental: {_RESULTS['incremental']}",
+            file=sys.stderr,
+        )
+
+        # Chunk-store dedup + codec headline (chunkstore.py): the
+        # unchanged-majority workload whose effective (logical-bytes)
+        # throughput is allowed to BEAT the D2H ceiling — unchanged
+        # chunks never cross the link. Bounded payload like the
+        # incremental section; degrades to a reduced payload on a tight
+        # budget instead of skipping.
+        _phase("dedup + codec (chunkstore)")
+        if _remaining_s() < 75:
+            _RESULTS["dedup_codec"] = {
+                "ok": False,
+                "skipped": "deadline",
+                "error": "skipped: hard deadline",
+            }
+            _note_gap(
+                "dedup_codec", "remaining budget below the section floor"
+            )
+        else:
+            try:
+                _RESULTS["dedup_codec"] = run_dedup_codec_block(
+                    bench_dir,
+                    d2h_gbps=None,  # probes adjacently inside
+                    reduced=_remaining_s() < 240,
+                )
+            except Exception as e:
+                _RESULTS["dedup_codec"] = {"ok": False, "error": repr(e)}
+        print(
+            f"[bench] dedup_codec: {_RESULTS['dedup_codec']}",
             file=sys.stderr,
         )
 

@@ -1,0 +1,160 @@
+"""Codec registry (torchsnapshot_tpu/codecs.py): lossless round-trips,
+the int8 quantizer's tolerance contract, and codec-plan resolution."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import codecs
+
+
+def _payload(n=5000, seed=0, dtype=np.float32):
+    return np.random.RandomState(seed).randn(n).astype(dtype)
+
+
+class TestLossless:
+    def test_zlib_round_trip_bit_exact(self):
+        raw = _payload().tobytes()
+        enc = codecs.encode("zlib", raw)
+        assert codecs.decode("zlib", enc) == raw
+
+    def test_identity(self):
+        raw = b"abc" * 100
+        assert codecs.encode(None, raw) == raw
+        assert codecs.decode(None, raw) == raw
+        assert codecs.encode("identity", raw) == raw
+
+    @pytest.mark.skipif(
+        "zstd" not in codecs.available_codecs(),
+        reason="no zstd backend importable in this environment",
+    )
+    def test_zstd_round_trip_bit_exact(self):
+        raw = _payload().tobytes()
+        enc = codecs.encode("zstd", raw)
+        assert codecs.decode("zstd", enc) == raw
+
+    def test_zstd_unavailable_raises_clearly(self):
+        if "zstd" in codecs.available_codecs():
+            pytest.skip("zstd available here")
+        with pytest.raises(codecs.CodecUnavailable):
+            codecs.check_codec("zstd")
+
+    def test_best_lossless_is_usable(self):
+        name = codecs.best_lossless()
+        raw = _payload().tobytes()
+        assert codecs.decode(name, codecs.encode(name, raw)) == raw
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(ValueError):
+            codecs.encode("lzma9000", b"x")
+        with pytest.raises(ValueError):
+            codecs.check_codec("lzma9000")
+
+
+class TestInt8:
+    @pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16"])
+    def test_within_documented_tolerance(self, dtype):
+        import ml_dtypes
+
+        np_dtype = (
+            ml_dtypes.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+        )
+        arr = _payload(4096 + 17, seed=3).astype(np_dtype)
+        enc = codecs.encode("int8", arr.tobytes(), dtype)
+        dec = np.frombuffer(codecs.decode("int8", enc, dtype), np_dtype)
+        err = np.abs(
+            dec.astype(np.float32) - arr.astype(np.float32)
+        ).max()
+        bound = codecs.quant_error_bound(
+            arr.astype(np.float32), dtype_name=dtype
+        )
+        assert 0 < err <= bound
+
+    def test_ratio_roughly_4x_for_float32(self):
+        raw = _payload(1 << 16).tobytes()
+        enc = codecs.encode("int8", raw, "float32")
+        assert len(enc) < 0.3 * len(raw)
+
+    def test_constant_block_is_exact(self):
+        arr = np.full(2048, 3.25, np.float32)
+        enc = codecs.encode("int8", arr.tobytes(), "float32")
+        dec = np.frombuffer(codecs.decode("int8", enc, "float32"), np.float32)
+        assert np.array_equal(dec, arr)
+
+    def test_nonfinite_payload_unsuitable(self):
+        arr = _payload(2048)
+        arr[100] = np.inf
+        with pytest.raises(codecs.CodecUnsuitable):
+            codecs.encode("int8", arr.tobytes(), "float32")
+
+    def test_int_dtype_unsuitable(self):
+        arr = np.arange(2048, dtype=np.int32)
+        with pytest.raises(codecs.CodecUnsuitable):
+            codecs.encode("int8", arr.tobytes(), "int32")
+
+    def test_frame_self_verifies(self):
+        raw = _payload(2048).tobytes()
+        enc = bytearray(codecs.encode("int8", raw, "float32"))
+        enc[-1] ^= 0xFF  # flip a quantized byte
+        with pytest.raises(RuntimeError, match="crc"):
+            codecs.decode("int8", bytes(enc), "float32")
+
+    def test_non_frame_bytes_rejected(self):
+        with pytest.raises(RuntimeError, match="TSQ1"):
+            codecs.decode("int8", b"not a frame at all", "float32")
+
+
+class TestPlans:
+    def test_none_spec_is_identity(self, monkeypatch):
+        monkeypatch.delenv("TPUSNAPSHOT_CODEC", raising=False)
+        plan = codecs.resolve_codec_plan(None)
+        assert plan.codec_for("model/w") is None
+
+    def test_bare_name_applies_everywhere(self):
+        plan = codecs.resolve_codec_plan("zlib")
+        assert plan.codec_for("model/w") == "zlib"
+        assert plan.codec_for("opt/mu/w") == "zlib"
+
+    def test_glob_mapping_specific_first(self):
+        plan = codecs.resolve_codec_plan({"opt/*": "int8", "*": "zlib"})
+        assert plan.codec_for("opt/mu/w", dtype_name="float32") == "int8"
+        assert plan.codec_for("model/w", dtype_name="float32") == "zlib"
+
+    def test_env_string_form(self, monkeypatch):
+        monkeypatch.setenv("TPUSNAPSHOT_CODEC", "opt/*=int8,*=zlib")
+        plan = codecs.resolve_codec_plan(None)
+        assert plan.codec_for("opt/nu/w", dtype_name="float32") == "int8"
+        assert plan.codec_for("model/w") == "zlib"
+
+    def test_lossy_fallback_rejected(self):
+        with pytest.raises(ValueError, match="explicit per-leaf glob"):
+            codecs.resolve_codec_plan("int8")
+        with pytest.raises(ValueError, match="explicit per-leaf glob"):
+            codecs.resolve_codec_plan({"*": "int8"})
+
+    def test_lossy_degrades_on_unquantizable_leaf(self):
+        plan = codecs.resolve_codec_plan({"opt/*": "int8"})
+        # int dtype and PRNG key data must never quantize.
+        assert plan.codec_for("opt/step", dtype_name="int64") is None
+        assert (
+            plan.codec_for(
+                "opt/key", dtype_name="uint32", prng_impl="threefry2x32"
+            )
+            is None
+        )
+
+    def test_lossy_degrade_falls_through_to_fallback_rule(self):
+        # An unquantizable leaf under a lossy glob still gets the
+        # user's lossless fallback, not raw identity.
+        plan = codecs.resolve_codec_plan({"opt/*": "int8", "*": "zlib"})
+        assert plan.codec_for("opt/step", dtype_name="int64") == "zlib"
+        assert (
+            plan.codec_for(
+                "opt/key", dtype_name="uint32", prng_impl="threefry2x32"
+            )
+            == "zlib"
+        )
+        assert plan.codec_for("opt/mu", dtype_name="float32") == "int8"
+
+    def test_identity_aliases(self):
+        plan = codecs.resolve_codec_plan({"*": "none"})
+        assert plan.codec_for("model/w") is None

@@ -58,6 +58,15 @@ _METRICS: List[Tuple[str, str, str]] = [
     ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
     ("read_fanout.amplification_served", "fanout amplification", "low"),
     ("read_fanout.served_gbps", "fanout GB/s", "high"),
+    # Chunk-store dedup + codec section (bench dedup_codec): physical
+    # fractions are lower-is-better (dedup saving fewer bytes is THE
+    # regression), the effective logical-bytes throughput is
+    # higher-is-better, and the codec ratio (stored/logical) is
+    # lower-is-better.
+    ("dedup_codec.second_take_physical_pct", "2nd-take physical %", "low"),
+    ("dedup_codec.dirty10_physical_pct", "10%-dirty physical %", "low"),
+    ("dedup_codec.effective_gbps", "dedup effective GB/s", "high"),
+    ("dedup_codec.codec_ratio", "codec ratio", "low"),
 ]
 
 
@@ -278,6 +287,40 @@ def _self_test() -> int:
     assert reg and "fanout GB/s" in reg[0], f"GB/s halving must fail: {reg}"
     _, reg = compare(base, fanout, 0.2)
     assert not reg, f"fanout keys absent on one side are skipped: {reg}"
+    # Dedup/codec keys: physical percentages and the codec ratio are
+    # lower-is-better (a RISE is the regression); effective GB/s is
+    # higher-is-better like every throughput.
+    dedup = dict(
+        base,
+        dedup_codec={
+            "second_take_physical_pct": 2.0,
+            "dirty10_physical_pct": 14.0,
+            "effective_gbps": 10.0,
+            "codec_ratio": 0.5,
+        },
+    )
+    _, reg = compare(dedup, dict(dedup), 0.2)
+    assert not reg, f"identical dedup runs must pass: {reg}"
+    worse_phys = dict(
+        dedup,
+        dedup_codec=dict(
+            dedup["dedup_codec"], second_take_physical_pct=4.0
+        ),
+    )
+    _, reg = compare(dedup, worse_phys, 0.2)
+    assert reg and "2nd-take" in reg[0], f"physical 2x must fail: {reg}"
+    worse_eff = dict(
+        dedup, dedup_codec=dict(dedup["dedup_codec"], effective_gbps=5.0)
+    )
+    _, reg = compare(dedup, worse_eff, 0.2)
+    assert reg and "effective" in reg[0], f"GB/s halving must fail: {reg}"
+    worse_ratio2 = dict(
+        dedup, dedup_codec=dict(dedup["dedup_codec"], codec_ratio=0.9)
+    )
+    _, reg = compare(dedup, worse_ratio2, 0.2)
+    assert reg and "codec ratio" in reg[0], f"ratio rise must fail: {reg}"
+    _, reg = compare(base, dedup, 0.2)
+    assert not reg, f"dedup keys absent on one side are skipped: {reg}"
     print("bench_compare self-test OK")
     return 0
 

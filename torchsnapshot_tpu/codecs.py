@@ -1,0 +1,404 @@
+"""Pluggable payload codecs for the content-addressed chunk store.
+
+The write pipeline gains one stage between serialization (raw
+little-endian payload bytes, serialization.py) and storage: each content
+chunk may be passed through a codec before it is written, with the codec
+name recorded per chunk in the manifest so the read pipeline can fuse
+the decode into the existing read→consume overlap (io_preparer.py).
+
+Codec taxonomy:
+
+- ``None`` / ``"identity"`` — stored bytes == logical bytes.
+- ``"zlib"`` — lossless deflate at level 1 (stdlib; always available).
+- ``"zstd"`` — lossless zstandard framing. Gated on an importable
+  backend (``compression.zstd`` on Python ≥ 3.14, else the
+  ``zstandard`` package); when neither is present the codec is simply
+  not offered (``available_codecs()``) and requesting it raises — the
+  container must never record a codec it cannot decode.
+- ``"int8"`` — LOSSY blockwise affine uint8 quantization for float
+  payloads (EQuARX, arxiv 2506.17615: int8 halving of distributed-ML
+  byte streams costs negligible quality; the same trade applies to
+  optimizer-moment checkpoint bytes). 4x smaller than float32 before
+  the scale sidecar (~0.8% overhead at the 1024-element block size).
+  Opt-in ONLY: a codec spec may apply ``int8`` exclusively through an
+  explicit per-leaf glob — a bare/default ``"int8"`` is rejected, so a
+  lossy codec can never reach a leaf nobody named.
+
+Error tolerance contract (``int8``): for each 1024-element block with
+value range ``r = max - min``, the absolute dequantization error is at
+most ``r / 510`` (half a quantization step), plus the target dtype's
+own rounding for sub-float32 dtypes. :func:`quant_error_bound` computes
+the documented bound for an array so tests and benches assert against
+the contract rather than a magic number. Payloads containing
+non-finite values raise :class:`CodecUnsuitable` at encode time — the
+caller degrades that chunk to the identity codec (never corrupt, only
+less compression).
+"""
+
+import fnmatch
+import logging
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# One-lookup backend gate for zstd. Python 3.14 ships compression.zstd;
+# earlier interpreters need the `zstandard` package. Neither being
+# present simply removes "zstd" from the offered codecs.
+_ZSTD_COMPRESS = None
+_ZSTD_DECOMPRESS = None
+try:  # pragma: no cover - depends on interpreter/packages
+    from compression import zstd as _stdlib_zstd  # type: ignore
+
+    _ZSTD_COMPRESS = _stdlib_zstd.compress
+    _ZSTD_DECOMPRESS = _stdlib_zstd.decompress
+except ImportError:
+    try:  # pragma: no cover - depends on installed packages
+        import zstandard as _zstandard  # type: ignore
+
+        _ZSTD_COMPRESS = lambda b, level=3: _zstandard.ZstdCompressor(  # noqa: E731
+            level=level
+        ).compress(bytes(b))
+        _ZSTD_DECOMPRESS = lambda b: _zstandard.ZstdDecompressor(  # noqa: E731
+            # Chunk payloads are bounded (TPUSNAPSHOT_CHUNK_BYTES), so an
+            # unbounded decompress window is not a resource hazard here.
+        ).decompress(bytes(b), max_output_size=1 << 31)
+    except ImportError:
+        pass
+
+LOSSLESS_CODECS = ("zlib",) + (("zstd",) if _ZSTD_COMPRESS else ())
+LOSSY_CODECS = ("int8",)
+
+_QUANT_MAGIC = b"TSQ1"
+_QUANT_BLOCK = 1024  # elements per scale block
+_QUANT_LEVELS = 255  # uint8 codes 0..255
+
+# float dtypes the quantizer accepts (everything it can round-trip
+# through float32 math without changing the CONTRACT above).
+_QUANTIZABLE_DTYPES = ("float32", "float16", "bfloat16", "float64")
+
+
+class CodecUnavailable(RuntimeError):
+    """The named codec's backend is not importable in this process."""
+
+
+class CodecUnsuitable(ValueError):
+    """The payload cannot go through this codec (non-float dtype for
+    int8, non-finite values, …). Callers degrade to identity."""
+
+
+def available_codecs() -> Tuple[str, ...]:
+    return LOSSLESS_CODECS + LOSSY_CODECS
+
+
+def is_lossy(name: Optional[str]) -> bool:
+    return name in LOSSY_CODECS
+
+
+def best_lossless() -> str:
+    """The strongest lossless codec this process can both encode AND
+    decode — ``zstd`` when a backend is importable, else ``zlib``."""
+    return "zstd" if _ZSTD_COMPRESS else "zlib"
+
+
+def check_codec(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if name == "zstd" and _ZSTD_COMPRESS is None:
+        raise CodecUnavailable(
+            'codec "zstd" needs the compression.zstd stdlib module '
+            "(Python >= 3.14) or the zstandard package; neither is "
+            'importable here. Use "zlib" or install a backend.'
+        )
+    if name not in available_codecs():
+        raise ValueError(
+            f"Unknown codec {name!r}. Available: "
+            f"{sorted(available_codecs())} (zstd only when a backend "
+            f"is importable)."
+        )
+
+
+# ------------------------------------------------------------------ int8
+
+
+def _as_float32(payload: Any, dtype_name: str) -> np.ndarray:
+    from .serialization import str_to_dtype
+
+    dtype = str_to_dtype(dtype_name)
+    arr = np.frombuffer(payload, dtype=dtype)
+    return arr.astype(np.float32)
+
+
+# Half-ulp relative rounding of the DEQUANTIZED value back into the
+# target dtype — the second error term of the int8 contract for
+# sub-float32 dtypes.
+_DTYPE_ROUND_EPS = {
+    "float64": 2.0**-52,
+    "float32": 2.0**-23,
+    "float16": 2.0**-11,
+    "bfloat16": 2.0**-8,
+}
+
+
+def quant_error_bound(
+    arr: np.ndarray, dtype_name: str = "float32"
+) -> float:
+    """The documented per-element absolute error bound for ``int8``
+    over ``arr`` restored as ``dtype_name``: max over 1024-element
+    blocks of ``range / 510`` (half a quantization step), plus the
+    target dtype's half-ulp rounding of the dequantized value.
+    Tests/benches assert restored values within this bound — the
+    contract, not an empirical fudge."""
+    flat = np.asarray(arr, dtype=np.float32).reshape(-1)
+    pad = (-flat.shape[0]) % _QUANT_BLOCK
+    if pad:
+        flat = np.concatenate([flat, np.repeat(flat[-1:], pad)])
+    blocks = flat.reshape(-1, _QUANT_BLOCK)
+    r = (blocks.max(axis=1) - blocks.min(axis=1)).max()
+    scale = float(r) / (2 * _QUANT_LEVELS)
+    eps = _DTYPE_ROUND_EPS.get(dtype_name, 2.0**-8)
+    return (
+        scale * (1.0 + 1e-5)
+        + 1e-6
+        + float(np.abs(flat).max() + 2 * scale) * eps
+    )
+
+
+def _quant_encode(payload: Any, dtype_name: str) -> bytes:
+    if dtype_name not in _QUANTIZABLE_DTYPES:
+        raise CodecUnsuitable(
+            f'codec "int8" quantizes float payloads only; dtype '
+            f"{dtype_name!r} is not quantizable"
+        )
+    x = _as_float32(payload, dtype_name)
+    if x.size == 0:
+        raise CodecUnsuitable("empty payload")
+    if not np.isfinite(x).all():
+        # Quantizing through an inf/nan block range would decode
+        # garbage for every element of the block: refuse, the caller
+        # stores this chunk with the identity codec instead.
+        raise CodecUnsuitable("payload contains non-finite values")
+    n = x.shape[0]
+    pad = (-n) % _QUANT_BLOCK
+    if pad:
+        x = np.concatenate([x, np.repeat(x[-1:], pad)])
+    blocks = x.reshape(-1, _QUANT_BLOCK)
+    mins = blocks.min(axis=1)
+    ranges = blocks.max(axis=1) - mins
+    scales = ranges / np.float32(_QUANT_LEVELS)
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    q = np.clip(
+        np.rint((blocks - mins[:, None]) / safe[:, None]),
+        0,
+        _QUANT_LEVELS,
+    ).astype(np.uint8)
+    name = dtype_name.encode()
+    side = np.stack(
+        [mins.astype(np.float32), scales.astype(np.float32)], axis=1
+    ).tobytes()
+    body = side + q.reshape(-1)[:n].tobytes()
+    # The frame carries its own body crc: content-addressed hit chunks
+    # record no per-chunk checksum in THEIR manifest (only the writing
+    # take's does), and the quantized payload cannot be verified against
+    # the logical-content fingerprint the chunk key embeds (the decode
+    # is lossy) — so the frame itself is the integrity anchor.
+    header = (
+        _QUANT_MAGIC
+        + struct.pack(
+            "<BIQI", len(name), _QUANT_BLOCK, n, zlib.crc32(body) & 0xFFFFFFFF
+        )
+        + name
+    )
+    return header + body
+
+
+def _quant_decode(payload: Any, dtype_name_hint: Optional[str]) -> bytes:
+    from .serialization import str_to_dtype
+
+    buf = bytes(payload)
+    if buf[:4] != _QUANT_MAGIC:
+        raise RuntimeError(
+            'stored chunk claims codec "int8" but carries no TSQ1 '
+            "frame — corrupt object or codec mismatch"
+        )
+    name_len, block, n, crc = struct.unpack_from("<BIQI", buf, 4)
+    off = 4 + struct.calcsize("<BIQI")
+    dtype_name = buf[off : off + name_len].decode()
+    off += name_len
+    if zlib.crc32(buf[off:]) & 0xFFFFFFFF != crc:
+        raise RuntimeError(
+            "int8 chunk frame is corrupt (body crc mismatch)"
+        )
+    n_blocks = (n + block - 1) // block
+    side = np.frombuffer(buf, dtype=np.float32, count=2 * n_blocks, offset=off)
+    off += side.nbytes
+    mins = side.reshape(-1, 2)[:, 0]
+    scales = side.reshape(-1, 2)[:, 1]
+    q = np.frombuffer(buf, dtype=np.uint8, count=n, offset=off).astype(
+        np.float32
+    )
+    pad = (-n) % block
+    if pad:
+        q = np.concatenate([q, np.zeros((pad,), np.float32)])
+    x = q.reshape(-1, block) * scales[:, None] + mins[:, None]
+    out = x.reshape(-1)[:n].astype(str_to_dtype(dtype_name))
+    return out.tobytes()
+
+
+# ------------------------------------------------------------ encode/decode
+
+
+def encode(
+    name: Optional[str], payload: Any, dtype_name: Optional[str] = None
+) -> bytes:
+    """Encode a logical payload through ``name``. ``dtype_name`` is
+    required by dtype-aware codecs (``int8``). Raises
+    :class:`CodecUnsuitable` when the payload cannot go through — the
+    chunk-store write path catches it and degrades to identity."""
+    if name is None or name == "identity":
+        return bytes(payload)
+    if name == "zlib":
+        return zlib.compress(payload, level=1)
+    if name == "zstd":
+        check_codec("zstd")
+        return _ZSTD_COMPRESS(bytes(payload), 3)
+    if name == "int8":
+        if dtype_name is None:
+            raise CodecUnsuitable('codec "int8" needs the payload dtype')
+        return _quant_encode(payload, dtype_name)
+    raise ValueError(f"Unknown codec {name!r}")
+
+
+def decode(
+    name: Optional[str], payload: Any, dtype_name: Optional[str] = None
+) -> bytes:
+    if name is None or name == "identity":
+        return bytes(payload)
+    if name == "zlib":
+        return zlib.decompress(payload)
+    if name == "zstd":
+        if _ZSTD_DECOMPRESS is None:
+            raise CodecUnavailable(
+                'this snapshot stores "zstd"-coded chunks but no zstd '
+                "backend is importable here (compression.zstd or the "
+                "zstandard package); install one to restore"
+            )
+        return _ZSTD_DECOMPRESS(bytes(payload))
+    if name == "int8":
+        return _quant_decode(payload, dtype_name)
+    raise ValueError(f"Unknown codec {name!r}")
+
+
+# -------------------------------------------------------------- codec plans
+
+
+CodecSpec = Union[None, str, Dict[str, Optional[str]]]
+
+
+class CodecPlan:
+    """Ordered (glob, codec) rules mapping leaf logical paths to chunk
+    codecs. Built once per take from the ``codec=`` argument or
+    ``TPUSNAPSHOT_CODEC``; first matching glob wins, ``"*"`` (or a bare
+    codec name) is the fallback. Lossy codecs must be EXPLICITLY
+    globbed — a plan whose fallback is lossy is rejected at build time,
+    so quantization can never reach a leaf nobody opted in."""
+
+    def __init__(self, rules: Sequence[Tuple[str, Optional[str]]]):
+        self.rules: List[Tuple[str, Optional[str]]] = list(rules)
+
+    def codec_for(
+        self,
+        logical_path: str,
+        dtype_name: Optional[str] = None,
+        prng_impl: Optional[str] = None,
+    ) -> Optional[str]:
+        for glob, codec in self.rules:
+            if glob == "*" or fnmatch.fnmatch(logical_path, glob):
+                if is_lossy(codec):
+                    # PRNG key data and non-float payloads are never
+                    # quantizable; fall THROUGH to the remaining rules
+                    # (the user's lossless fallback still applies)
+                    # rather than fail the take.
+                    if prng_impl is not None or (
+                        dtype_name is not None
+                        and dtype_name not in _QUANTIZABLE_DTYPES
+                    ):
+                        logger.warning(
+                            f'codec "int8" matched {logical_path!r} but '
+                            f"the leaf is not quantizable (dtype "
+                            f"{dtype_name!r}, prng={prng_impl!r}); "
+                            f"trying the remaining codec rules"
+                        )
+                        continue
+                return codec
+        return None
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, CodecPlan) and self.rules == other.rules
+
+
+def _normalize_name(raw: str) -> Optional[str]:
+    name = raw.strip().lower()
+    if name in ("", "none", "identity", "raw"):
+        return None
+    return name
+
+
+def resolve_codec_plan(spec: CodecSpec) -> CodecPlan:
+    """Build a :class:`CodecPlan` from the take's ``codec=`` argument.
+
+    Accepted shapes::
+
+        None                          -> TPUSNAPSHOT_CODEC env (or identity)
+        "zstd"                        -> every chunked leaf
+        {"opt/**": "int8", "*": "zstd"}
+        "opt/**=int8,*=zstd"          -> the env-var string form
+
+    Every named codec is validated for availability here (take time),
+    never at restore time; a lossy fallback rule raises.
+    """
+    import os
+
+    if spec is None:
+        spec = os.environ.get("TPUSNAPSHOT_CODEC") or None
+    rules: List[Tuple[str, Optional[str]]] = []
+    if spec is None:
+        return CodecPlan([])
+    if isinstance(spec, str) and ("=" in spec or "," in spec):
+        parsed: Dict[str, Optional[str]] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                glob, _, name = part.partition("=")
+                parsed[glob.strip()] = _normalize_name(name)
+            else:
+                parsed["*"] = _normalize_name(part)
+        spec = parsed
+    if isinstance(spec, str):
+        spec = {"*": _normalize_name(spec)}
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"codec spec must be a codec name or a {{glob: codec}} "
+            f"mapping; got {type(spec).__name__}"
+        )
+    # Specific globs first, "*" fallback last; among explicit globs the
+    # caller's insertion order is preserved (dicts are ordered).
+    items = [(g, c) for g, c in spec.items() if g != "*"]
+    if "*" in spec:
+        items.append(("*", spec["*"]))
+    for glob, name in items:
+        codec = _normalize_name(name) if isinstance(name, str) else name
+        check_codec(codec)
+        if is_lossy(codec) and glob == "*":
+            raise ValueError(
+                f'lossy codec {codec!r} requires an explicit per-leaf '
+                f'glob (e.g. {{"opt/**": "{codec}"}}); refusing to '
+                f"quantize every leaf by default"
+            )
+        rules.append((glob, codec))
+    return CodecPlan(rules)

@@ -102,7 +102,22 @@ def assert_reclaimed(base_url: str, live_steps: Sequence[int]) -> None:
     The telemetry ledger (``.telemetry/``, telemetry/ledger.py) is
     durable metadata by contract — its records describe the run, not
     any one step, and survive prune/reconcile by design — so it is
-    never a leak (torn ``*.tmp<pid>`` debris under it still is)."""
+    never a leak (torn ``*.tmp<pid>`` debris under it still is).
+
+    The content-addressed chunk store (``.chunkstore/``, chunkstore.py)
+    is leak-checked BY REFERENCE: chunk objects some live step's
+    committed manifest names are allowed (they are that step's
+    payload), as is each live step's ref doc; everything else under the
+    store — unreferenced chunks, stale refs, intents — is a leak the
+    recovery should have reclaimed."""
+    from ..chunkstore import (
+        STORE_DIRNAME,
+        REFS_PREFIX,
+        chunk_keys_of,
+        chunk_object_path,
+        ref_doc_name,
+    )
+    from ..snapshot import Snapshot
     from ..telemetry.ledger import LEDGER_DIR
 
     import re
@@ -110,6 +125,25 @@ def assert_reclaimed(base_url: str, live_steps: Sequence[int]) -> None:
     live = set(live_steps)
     allowed_markers = {f"{_STEP_PREFIX}{s}" for s in live}
     allowed_prefixes = tuple(f"step-{s}/" for s in live)
+    store_prefix = f"{STORE_DIRNAME}/"
+    allowed_store: set = set()
+    for s in sorted(live):
+        step_url = _step_dir(base_url, s)
+        try:
+            manifest = Snapshot(step_url).get_manifest()
+        # A live step whose metadata cannot be read fails the recovery
+        # invariant itself; here it only shrinks the allow-set, which
+        # can't hide a leak.
+        except Exception:  # snapcheck: disable=swallowed-exception -- allow-set probe
+            continue
+        keys = chunk_keys_of(manifest)
+        if keys:
+            allowed_store.add(
+                f"{store_prefix}{REFS_PREFIX}{ref_doc_name(step_url)}"
+            )
+            allowed_store.update(
+                f"{store_prefix}{chunk_object_path(k)}" for k in keys
+            )
     storage = url_to_storage_plugin(base_url)
     try:
         objs = asyncio.run(storage.list_prefix("")) or []
@@ -126,6 +160,7 @@ def assert_reclaimed(base_url: str, live_steps: Sequence[int]) -> None:
         for o in objs
         if o not in allowed_markers
         and not o.startswith(allowed_prefixes)
+        and not (o.startswith(store_prefix) and o in allowed_store)
         and not _is_ledger(o)
     ]
     assert not leaked, (

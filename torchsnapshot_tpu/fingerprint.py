@@ -203,6 +203,122 @@ def resolve_fingerprints(results: list) -> list:
     return out
 
 
+# --------------------------------------------------------- chunked variants
+#
+# Per-chunk fingerprints for the content-addressed chunk store
+# (chunkstore.py): the logical payload is split into fixed-size byte
+# chunks and each chunk is fingerprinted INDEPENDENTLY, with weights
+# indexed from the chunk's own start — so a chunk's fingerprint equals
+# :func:`fingerprint_host` of exactly that byte slice, and the same
+# bytes appearing at the same chunk-grid position in a later take hash
+# to the same content key. One jitted pass computes every chunk's four
+# lanes (a (n_chunks, 4) device array): HBM-bandwidth bound, resolved
+# with ONE device→host fetch per leaf.
+
+
+@partial(jax.jit, static_argnames=("chunk_words",))
+def _fingerprint_device_chunked_jit(
+    x: jax.Array, chunk_words: int
+) -> jax.Array:
+    w = _device_words(x)
+    pad = (-w.shape[0]) % chunk_words
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad,), dtype=jnp.uint32)])
+    rows = w.reshape(-1, chunk_words)
+    # Within-chunk indices: zero-padding a short tail chunk adds 0*m
+    # terms, so the result equals fingerprint_host of the unpadded
+    # slice (which pads to a word boundary the same way).
+    i = lax.iota(jnp.uint32, chunk_words)
+    lanes = []
+    for k in range(_N_LANES):
+        salt = (int(_SALT) * k + 1) & 0xFFFFFFFF
+        m = _mix_u32(i * jnp.uint32(_GOLD) + jnp.uint32(salt))
+        lanes.append(jnp.sum(rows * m[None, :], axis=1, dtype=jnp.uint32))
+    return jnp.stack(lanes, axis=1)
+
+
+def fingerprint_device_chunked_async(
+    x: jax.Array, chunk_bytes: int
+) -> jax.Array:
+    """Dispatch per-chunk fingerprints over ``x``'s stored-byte layout,
+    ``chunk_bytes`` per chunk (must be a positive multiple of 4);
+    returns the (n_chunks, 4)-uint32 result WITHOUT blocking. Resolve
+    with :func:`resolve_chunk_fingerprints` (or ``np.asarray``)."""
+    if chunk_bytes <= 0 or chunk_bytes % 4:
+        raise ValueError(
+            f"chunk_bytes must be a positive multiple of 4; got "
+            f"{chunk_bytes}"
+        )
+    return _fingerprint_device_chunked_jit(x, chunk_bytes // 4)
+
+
+def resolve_chunk_fingerprints(results: list) -> list:
+    """Resolve a batch of :func:`fingerprint_device_chunked_async`
+    results; each output element is a list of fingerprint strings (one
+    per chunk) or the per-item ``Exception``."""
+    out: list = []
+    for r in results:
+        try:
+            rows = np.asarray(r)
+            out.append([format_fingerprint(row) for row in rows])
+        except Exception as e:
+            out.append(e)
+    return out
+
+
+def fingerprint_host_chunked(data: Any, chunk_bytes: int) -> list:
+    """Per-chunk fingerprints of host bytes / a numpy array, matching
+    :func:`fingerprint_host` over each ``chunk_bytes`` slice of the
+    C-order little-endian payload.
+
+    Bounded memory like :func:`fingerprint_host`: rows are processed in
+    ≤ ``_HOST_CHUNK_WORDS``-word batches with plain uint32 wraparound
+    arithmetic (one batch-sized product transient, never a
+    payload-sized one), and only the tail chunk is pad-copied — a
+    multi-GiB host-staged leaf must not double its RSS to be
+    fingerprinted."""
+    if chunk_bytes <= 0 or chunk_bytes % 4:
+        raise ValueError(
+            f"chunk_bytes must be a positive multiple of 4; got "
+            f"{chunk_bytes}"
+        )
+    if isinstance(data, np.ndarray):
+        if data.dtype == np.bool_:
+            data = data.astype(np.uint8)
+        buf = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    else:
+        buf = np.frombuffer(data, dtype=np.uint8)
+    n = buf.shape[0]
+    chunk_words = chunk_bytes // 4
+    n_full = n // chunk_bytes
+    n_chunks = -(-n // chunk_bytes) if n else 0
+    i = np.arange(chunk_words, dtype=np.uint32)
+    ms = []
+    for k in range(_N_LANES):
+        salt = np.uint32((int(_SALT) * k + 1) & 0xFFFFFFFF)
+        ms.append(_mix_u32_np(i * _GOLD + salt))
+    out = np.zeros((n_chunks, _N_LANES), dtype=np.uint32)
+    body = buf[: n_full * chunk_bytes].view(np.uint32)
+    batch_rows = max(1, _HOST_CHUNK_WORDS // chunk_words)
+    for start in range(0, n_full, batch_rows):
+        stop = min(n_full, start + batch_rows)
+        rows = body[start * chunk_words : stop * chunk_words].reshape(
+            stop - start, chunk_words
+        )
+        for k in range(_N_LANES):
+            out[start:stop, k] = np.sum(
+                rows * ms[k][None, :], axis=1, dtype=np.uint32
+            )
+    if n_chunks > n_full:
+        tail = buf[n_full * chunk_bytes :]
+        padded = np.zeros((chunk_bytes,), dtype=np.uint8)
+        padded[: tail.shape[0]] = tail
+        words = padded.view(np.uint32)
+        for k in range(_N_LANES):
+            out[n_full, k] = np.sum(words * ms[k], dtype=np.uint32)
+    return [format_fingerprint(row) for row in out]
+
+
 # ------------------------------------------------------------------- host
 
 _HOST_CHUNK_WORDS = 1 << 22  # 16 MiB per pass

@@ -273,6 +273,10 @@ def _dense_match(
         and isinstance(base_entry, ArrayEntry)
         and base_entry.fingerprint == fp
         and base_entry.checksum is not None
+        # Chunk-stored base entries (chunkstore.py) have no single
+        # borrowable object — the chunk pass dedups them per chunk
+        # against the shared store instead.
+        and not base_entry.chunks
         and base_entry.dtype == entry.dtype
         and list(base_entry.shape) == list(entry.shape)
         and base_entry.prng_impl == entry.prng_impl
@@ -373,6 +377,7 @@ def apply_incremental(
                     candidate is None
                     or candidate.fingerprint != fp
                     or candidate.checksum is None
+                    or candidate.chunks
                     or candidate.dtype != chunk.dtype
                 ):
                     continue

@@ -834,6 +834,148 @@ class _SubRangeConsumer(BufferConsumer):
         self._state.note_device_cost(region.nbytes)
 
 
+class _ContentChunksReadState:
+    """Reassembles the content-addressed chunks of ONE stored object
+    (chunkstore.py manifest entries) into its logical payload, then
+    runs the real consumer on the whole payload — the chunk-store
+    mirror of :class:`_SplitObjectReadState`, with per-chunk codec
+    decode and content verification fused into the consume executor so
+    decodes overlap reads still in flight.
+
+    Integrity per chunk, independent of which take wrote it:
+    losslessly-coded chunks must fingerprint back to the content key
+    (xs128 of the logical bytes — stronger than a crc, and available
+    even for chunks this manifest only references); lossy (int8)
+    chunks verify their self-checking frame. Stored-size and stored-crc
+    checks additionally apply where this manifest recorded them (the
+    chunks its own take wrote)."""
+
+    def __init__(
+        self,
+        inner: BufferConsumer,
+        records: List[Dict[str, Any]],
+        dtype_name: str,
+        store_base: Optional[int],
+    ) -> None:
+        self._inner = inner
+        self._records = records
+        self._dtype_name = dtype_name
+        self._store_base = store_base
+        self.nbytes = sum(int(r["n"]) for r in records)
+        self._buf: Optional[bytearray] = None
+        self._remaining = len(records)
+        self._lock = threading.Lock()
+        self._cost_release: Optional[Callable[[int], None]] = None
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        self._cost_release = release
+
+    def build_reads(self) -> List[ReadReq]:
+        from .chunkstore import chunk_object_path
+        from .storage_plugin import make_ref_location
+
+        reqs: List[ReadReq] = []
+        offset = 0
+        for i, rec in enumerate(self._records):
+            path = chunk_object_path(rec["k"])
+            if self._store_base is not None:
+                path = make_ref_location(self._store_base, path)
+            reqs.append(
+                ReadReq(
+                    path=path,
+                    buffer_consumer=_ContentChunkConsumer(
+                        self, rec, offset, first=(i == 0)
+                    ),
+                )
+            )
+            offset += int(rec["n"])
+        return reqs
+
+    def _decode_and_verify(self, rec: Dict[str, Any], buf: BufferType) -> bytes:
+        from .chunkstore import decode_and_verify_chunk
+
+        return decode_and_verify_chunk(rec, self._dtype_name, buf)
+
+    async def absorb(
+        self,
+        rec: Dict[str, Any],
+        offset: int,
+        buf: BufferType,
+        executor: Optional[Executor] = None,
+    ) -> None:
+        def _consume_part() -> None:
+            logical = self._decode_and_verify(rec, buf)
+            with self._lock:
+                if self._buf is None:
+                    self._buf = bytearray(self.nbytes)
+            # Disjoint offsets: concurrent executor threads never overlap.
+            memoryview(self._buf)[offset : offset + len(logical)] = logical
+
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(executor, _consume_part)
+        else:
+            _consume_part()
+        with self._lock:
+            self._remaining -= 1
+            last = self._remaining == 0
+        if last:
+            try:
+                await self._inner.consume_buffer(
+                    memoryview(self._buf), executor
+                )
+            finally:
+                self._buf = None  # free eagerly
+                release, self._cost_release = self._cost_release, None
+                if release is not None:
+                    release(self.nbytes)
+
+
+class _ContentChunkConsumer(BufferConsumer):
+    """One content chunk of a chunk-stored object."""
+
+    def __init__(
+        self,
+        state: _ContentChunksReadState,
+        rec: Dict[str, Any],
+        offset: int,
+        first: bool,
+    ) -> None:
+        self._state = state
+        self._rec = rec
+        self._offset = offset
+        self._first = first
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        await self._state.absorb(self._rec, self._offset, buf, executor)
+
+    def _part_cost(self) -> int:
+        # Stored bytes held during the read + the decoded transient.
+        rec = self._rec
+        return int(rec.get("sn") or rec["n"]) + int(rec["n"])
+
+    def get_consuming_cost_bytes(self) -> int:
+        # The first chunk additionally carries the shared assembly
+        # buffer (released when the LAST chunk lands) — the same
+        # charging discipline as split whole-object reads.
+        return self._part_cost() + (self._state.nbytes if self._first else 0)
+
+    def get_deferred_cost_bytes(self) -> int:
+        return self._state.nbytes if self._first else 0
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        self._state.set_cost_releaser(release)
+
+    @property
+    def sort_key_bytes(self) -> int:
+        # All of one object's chunk reads share the object's logical
+        # size so the largest-first stable sort keeps the group
+        # contiguous (same convention as split sub-reads).
+        return self._state.nbytes
+
+
 class ArrayRestorePlan:
     """Plans and finalizes the restore of one array entry into a template.
 
@@ -843,6 +985,9 @@ class ArrayRestorePlan:
     """
 
     def __init__(self, entry: Entry, template: Any, callback: Callable[[Any], None]):
+        # Tuple tail: the stored object's own ArrayEntry — needed by the
+        # content-chunk branch (chunkstore.py entries read per content
+        # chunk instead of per stored object).
         if isinstance(entry, ShardedArrayEntry):
             dtype_name, shape = entry.dtype, list(entry.shape)
             chunks = [
@@ -852,6 +997,7 @@ class ArrayRestorePlan:
                     s.array.location,
                     s.array.checksum,
                     s.array.compression,
+                    s.array,
                 )
                 for s in entry.shards
             ]
@@ -864,6 +1010,7 @@ class ArrayRestorePlan:
                     entry.location,
                     entry.checksum,
                     entry.compression,
+                    entry,
                 )
             ]
         else:
@@ -937,7 +1084,7 @@ class ArrayRestorePlan:
 
         # Pass 1: overlaps of every chunk against every region.
         planned = []  # (chunk fields..., copies)
-        for chunk_off, chunk_sz, location, chunk_checksum, compression in self._chunks:
+        for chunk_off, chunk_sz, location, chunk_checksum, compression, aentry in self._chunks:
             copies: List[Tuple[_TargetRegion, Tuple[slice, ...], Overlap]] = []
             for region in self._regions:
                 ov = compute_overlap(chunk_off, chunk_sz, region.offsets, region.sizes)
@@ -945,7 +1092,8 @@ class ArrayRestorePlan:
                     copies.append((region, ov.target_slices, ov))
             if copies:
                 planned.append(
-                    (chunk_off, chunk_sz, location, chunk_checksum, compression, copies)
+                    (chunk_off, chunk_sz, location, chunk_checksum,
+                     compression, aentry, copies)
                 )
 
         # Pass 2: pick the regions whose ENTIRE payload can stream to
@@ -960,7 +1108,7 @@ class ArrayRestorePlan:
         stream_region: Dict[int, Dict[int, int]] = {}  # id(region) -> {id(ov): flat_base}
         by_region: Dict[int, List] = {}
         for item in planned:
-            for region, _, ov in item[5]:
+            for region, _, ov in item[6]:
                 by_region.setdefault(id(region), []).append((item, ov))
         region_by_id = {id(r): r for r in self._regions}
         for rid, items in by_region.items():
@@ -976,12 +1124,17 @@ class ArrayRestorePlan:
                 continue
             flat_bases: Dict[int, int] = {}
             ok = True
-            for (chunk_off, chunk_sz, _, _, compression, copies), ov in items:
+            for (chunk_off, chunk_sz, _, _, compression, aentry,
+                 copies), ov in items:
                 run = contiguous_byte_range(
                     region.sizes, ov.target_slices, itemsize
                 )
                 if (
                     compression is not None
+                    # Content-chunked stored objects (chunkstore.py)
+                    # assemble from per-chunk decodes on host — they
+                    # cannot stream raw byte ranges to device.
+                    or getattr(aentry, "chunks", None)
                     or len(copies) != 1
                     or run is None
                     or any(
@@ -1001,8 +1154,35 @@ class ArrayRestorePlan:
                 region.device_chunks = {}
 
         # Pass 3: emit read requests.
-        for chunk_off, chunk_sz, location, chunk_checksum, compression, copies in planned:
+        for (chunk_off, chunk_sz, location, chunk_checksum, compression,
+             aentry, copies) in planned:
             chunk_nbytes = _chunk_nbytes(chunk_sz, itemsize)
+            content = getattr(aentry, "chunks", None)
+            if content:
+                # Content-chunked stored object (chunkstore.py): one
+                # read per content chunk, each decoded (codec) and
+                # content-verified in the consume executor — decode
+                # overlaps the remaining reads — then scattered into
+                # the overlapping regions exactly like a whole-object
+                # read would be.
+                inner = _ChunkCopyConsumer(
+                    view_shape=list(chunk_sz),
+                    dtype=self._dtype,
+                    copies=[
+                        (region, region_slices, ov.chunk_slices)
+                        for region, region_slices, ov in copies
+                    ],
+                    on_done=self._on_req_done,
+                )
+                n_logical += 1
+                state = _ContentChunksReadState(
+                    inner,
+                    content,
+                    dtype_name=aentry.dtype,
+                    store_base=getattr(aentry, "base", None),
+                )
+                reqs.extend(state.build_reads())
+                continue
             # Sub-range boundaries must land on element boundaries for
             # streaming device chunks.
             part = max(

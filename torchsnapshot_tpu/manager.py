@@ -82,6 +82,8 @@ class CheckpointManager:
         reconcile_on_init: Optional[str] = None,
         incremental: bool = False,
         full_period: Optional[int] = None,
+        chunks: Optional[bool] = None,
+        codec: Optional[Any] = None,
     ) -> None:
         """``max_to_keep`` bounds retained checkpoints; ``keep_period``
         additionally ARCHIVES every checkpoint whose step is a multiple
@@ -101,6 +103,17 @@ class CheckpointManager:
         base stays pinned — without it, a never-changing array keeps
         its original writer retained for the whole run (which is
         correct, merely unbounded).
+
+        ``chunks``/``codec`` enable the content-addressed chunk store
+        for every save (chunkstore.py; defaults from
+        ``TPUSNAPSHOT_CHUNKS``/``TPUSNAPSHOT_CODEC``): the manager's
+        ``step-<N>`` layout puts the shared store at
+        ``<base>/.chunkstore``, consecutive saves share unchanged
+        chunks by content hash with no ``base=`` plumbing, and
+        retention prunes free chunks through refcounted GC instead of
+        the refuse-on-back-link model. Composes with
+        ``incremental=True`` (leaf hits are cheaper than N chunk
+        hits; chunking catches the partially-dirty remainder).
 
         ``reconcile_on_init`` ("adopt" or "sweep") runs
         :meth:`reconcile` once at construction — the job-startup hook
@@ -126,6 +139,8 @@ class CheckpointManager:
         self.keep_period = keep_period
         self.incremental = incremental
         self.full_period = full_period
+        self.chunks = chunks
+        self.codec = codec
         self._coord = coord
         # Last step committed THROUGH this manager instance + its
         # handle, reused as the next incremental base (seeded metadata
@@ -289,6 +304,7 @@ class CheckpointManager:
             self._clean_torn_control_files(storage)
             self._clean_progress_debris(storage, objs)
             self._reconcile_hot_tier(committed, marked, tombstoned)
+            self._reconcile_chunkstore(storage)
             return handled
         finally:
             storage.close()
@@ -420,6 +436,26 @@ class CheckpointManager:
         except Exception as e:
             logger.warning(f"reconcile: hot-tier buffer sweep failed: {e!r}")
 
+    def _reconcile_chunkstore(self, storage: Any) -> None:
+        """Sweep the run's content-addressed chunk store
+        (``<base>/.chunkstore``, chunkstore.py): stale take intents,
+        stale ref docs (uncommitted + aged), and chunk objects no live
+        committed manifest references — the re-drive for any chunk GC a
+        crashed ``Snapshot.delete`` left half-done. Cheap when the run
+        never chunked (one empty listing); best-effort like every
+        debris pass."""
+        try:
+            probe = asyncio.run(
+                storage.list_prefix(".chunkstore/")
+            )
+            if not probe:
+                return
+            from . import chunkstore
+
+            chunkstore.reconcile_store(self.base_path)
+        except Exception as e:
+            logger.warning(f"reconcile: chunk-store sweep failed: {e!r}")
+
     def _clean_progress_debris(self, storage: Any, objs) -> None:
         """Reclaim orphaned ``step-<N>/.progress/<take_id>/<rank>``
         records from crashed takes (same convention as the ``.report/``
@@ -494,6 +530,8 @@ class CheckpointManager:
             compression=compression,
             base=self._incremental_base(step, coordinator),
             fingerprint=True if self.incremental else None,
+            chunks=self.chunks,
+            codec=self.codec,
         )
         self._finalize(step, coordinator)
         # Every rank retains the handle: sync KV-route commits seed ALL
@@ -525,6 +563,8 @@ class CheckpointManager:
             stage=stage,
             base=self._incremental_base(step, coordinator),
             fingerprint=True if self.incremental else None,
+            chunks=self.chunks,
+            codec=self.codec,
         )
         return PendingManagedSnapshot(self, step, pending, coordinator)
 

@@ -77,7 +77,20 @@ class ArrayEntry(Entry):
     # the snapshot root named by `SnapshotMetadata.base_paths[base]`
     # instead of this snapshot's own root (the payload was unchanged
     # since that base take and was never rewritten). None = own root.
+    # For CONTENT-CHUNKED entries (chunks below), `base` instead names
+    # the run's shared chunk store root — the entry's bytes live there
+    # as content-addressed chunk objects, and `location` is the
+    # entry's natural (never-written) location kept for naming only.
     base: Optional[int] = None
+    # Content-addressed chunk records (chunkstore.py). When set, the
+    # payload is stored as a sequence of chunk objects under the chunk
+    # store named by `base`; each record is a compact dict:
+    #   {"k": content key ("xs128:<hex>-<nbytes>-<codec>"),
+    #    "n": logical (decoded) bytes, "sn": stored (encoded) bytes,
+    #    "c": codec name or None, "cs": "crc32:<hex>" of stored bytes}
+    # `checksum`/`compression` are None for chunked entries — integrity
+    # and codecs are per chunk.
+    chunks: Optional[List[Dict[str, Any]]] = None
 
     def __init__(
         self,
@@ -91,6 +104,7 @@ class ArrayEntry(Entry):
         compression: Optional[str] = None,
         fingerprint: Optional[str] = None,
         base: Optional[int] = None,
+        chunks: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -103,6 +117,7 @@ class ArrayEntry(Entry):
         self.compression = compression
         self.fingerprint = fingerprint
         self.base = base
+        self.chunks = chunks
 
 
 @dataclass
@@ -255,6 +270,8 @@ def _array_entry_dict(e: "ArrayEntry") -> Dict[str, Any]:
         d.pop("fingerprint", None)
     if d.get("base") is None:
         d.pop("base", None)
+    if d.get("chunks") is None:
+        d.pop("chunks", None)
     return d
 
 
@@ -307,6 +324,7 @@ def _array_entry_from_dict(d: Dict[str, Any]) -> "ArrayEntry":
         "compression": get("compression"),
         "fingerprint": get("fingerprint"),
         "base": get("base"),
+        "chunks": get("chunks"),
     }
     return e
 
@@ -473,6 +491,25 @@ class SnapshotMetadata:
         )
 
 
+def entry_has_content(entry: Entry) -> bool:
+    """Whether this entry PROVABLY describes stored bytes: it carries a
+    payload checksum (the stripe owner staged the bytes) or
+    content-addressed chunk records (the bytes live in the chunk
+    store). Replicated values mirror one entry per rank, and only the
+    writing owner's mirror satisfies this — restore/verify/copy must
+    prefer it, because non-owner mirrors may name locations that were
+    never written (leaf-dedup'd or chunk-stored by the owner)."""
+    if isinstance(entry, ShardedArrayEntry):
+        return any(
+            s.array.checksum is not None or s.array.chunks
+            for s in entry.shards
+        )
+    return (
+        getattr(entry, "checksum", None) is not None
+        or getattr(entry, "chunks", None) is not None
+    )
+
+
 def is_replicated(entry: Entry) -> bool:
     return (
         isinstance(
@@ -528,12 +565,13 @@ def get_available_entries(manifest: Manifest, rank: int) -> Manifest:
                 for shard in entry.shards:
                     key = (tuple(shard.offsets), tuple(shard.sizes))
                     current = merged.get(key)
-                    # Prefer the checksum-bearing duplicate: for chunked
-                    # replicated entries only the stripe owner staged the
-                    # bytes, so only its shard entries carry checksums.
+                    # Prefer the content-bearing duplicate (checksum or
+                    # chunk records): for chunked replicated entries
+                    # only the stripe owner staged the bytes, so only
+                    # its shard entries prove stored content.
                     if current is None or (
-                        current.array.checksum is None
-                        and shard.array.checksum is not None
+                        not entry_has_content(current.array)
+                        and entry_has_content(shard.array)
                     ):
                         merged[key] = shard
             available[local_path] = ShardedArrayEntry(
@@ -544,14 +582,12 @@ def get_available_entries(manifest: Manifest, rank: int) -> Manifest:
                 replicated=sample.replicated,
             )
         elif is_replicated(sample):
-            # Prefer the entry carrying a checksum: only the stripe owner
-            # (the rank whose bytes were stored) records one.
+            # Prefer the entry carrying proof of stored content
+            # (checksum, or chunk records for chunk-stored payloads):
+            # only the stripe owner — the rank whose bytes were
+            # actually stored — records either.
             available[local_path] = next(
-                (
-                    e
-                    for e in by_rank.values()
-                    if getattr(e, "checksum", None) is not None
-                ),
+                (e for e in by_rank.values() if entry_has_content(e)),
                 sample,
             )
         elif isinstance(sample, (ListEntry, DictEntry)):

@@ -231,6 +231,20 @@ async def execute_write_reqs(
                             len(buf),
                             progress,
                         )
+                        # Codec stage (chunkstore.py ChunkStager): the
+                        # encode ran inside the stage above; surface it
+                        # as its own op so flight reports separate
+                        # "device→host + serialize" from "compress/
+                        # quantize" CPU time. Credits no progress bytes
+                        # (the stage op already did).
+                        enc = getattr(
+                            wr.buffer_stager, "encode_stats", None
+                        )
+                        if enc is not None:
+                            _observe_op(ops, "encode", enc[0], enc[1])
+                            telemetry.counter(
+                                _metric_names.CODEC_SECONDS, op="encode"
+                            ).inc(enc[0])
                         return buf
 
                     task = asyncio.ensure_future(_stage())

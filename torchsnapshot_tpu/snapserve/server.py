@@ -442,8 +442,16 @@ class ReadService:
         first version (a watcher polling progress through the service
         would see a frozen record forever). Payload locations
         (``<rank>/…``, ``replicated/…``, ``chunked/…``) are
-        write-once-per-manifest and cache fine."""
-        return path.startswith(".") or path.startswith("refs/")
+        write-once-per-manifest and cache fine. Chunk-store GC state
+        (``refs/``, ``intents/`` under a ``.chunkstore`` root) is
+        mutable and bypasses too — but ``objects/…`` chunk payloads
+        are content-addressed and cache best of all (keyed by their
+        embedded content hash below)."""
+        return (
+            path.startswith(".")
+            or path.startswith("refs/")
+            or path.startswith("intents/")
+        )
 
     async def _read_backend(
         self,
@@ -507,11 +515,25 @@ class ReadService:
         if self._is_control_path(path):
             data = await self._read_backend(backend_url, path)
             return data, "backend", False
-        memo = await self._manifest_memo(backend_url)
-        # Locations the manifest records no checksum for key against
-        # the manifest GENERATION tag instead: a re-take rolls the tag,
-        # so stale cache entries become unreachable past the meta TTL.
-        checksum = memo.checksums.get(path) or memo.tag
+        from ..chunkstore import content_address_of
+
+        content_key = content_address_of(path)
+        if content_key is not None:
+            # Content-addressed chunk object (chunkstore.py): the path
+            # EMBEDS the content identity, so the cache key needs no
+            # manifest checksum map at all — a re-take of a mostly-
+            # unchanged model references the same chunk keys, and the
+            # fleet's cache stays warm across manifest generations
+            # (manifest-tag keying would invalidate everything). First
+            # step of the ROADMAP's chunk-level-pushdown item.
+            checksum = content_key
+        else:
+            memo = await self._manifest_memo(backend_url)
+            # Locations the manifest records no checksum for key
+            # against the manifest GENERATION tag instead: a re-take
+            # rolls the tag, so stale cache entries become unreachable
+            # past the meta TTL.
+            checksum = memo.checksums.get(path) or memo.tag
         key = f"{backend_url}\n{path}\n{checksum}"
         cached = self.cache.get(key)
         self._record_cache_events()

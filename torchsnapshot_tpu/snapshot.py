@@ -145,6 +145,8 @@ class Snapshot:
         compression: Optional[str] = None,
         base: Optional[Any] = None,
         fingerprint: Optional[bool] = None,
+        chunks: Optional[bool] = None,
+        codec: Optional[Any] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` to ``path``; returns a handle.
 
@@ -163,12 +165,25 @@ class Snapshot:
         take to use THIS snapshot as a base); default: on when ``base``
         is given or ``TPUSNAPSHOT_FINGERPRINT=1``. Like ``path``, both
         must be uniform across ranks.
+
+        ``chunks`` (or ``TPUSNAPSHOT_CHUNKS=1``) enables the
+        content-addressed chunk store (chunkstore.py): array payloads
+        split into ``TPUSNAPSHOT_CHUNK_BYTES`` chunks, fingerprinted on
+        device, and persisted only when no committed snapshot in the
+        run already stores those bytes — consecutive takes share
+        unchanged chunks even when a leaf is only partially dirty, with
+        no ``base=`` argument needed. ``codec`` selects the per-chunk
+        codec stage (codecs.py): a name ("zstd"/"zlib"), a
+        ``{glob: codec}`` mapping, or the ``TPUSNAPSHOT_CODEC`` env
+        default; the lossy ``"int8"`` codec applies only through an
+        explicit glob (e.g. ``{"opt/**": "int8"}``). Both are
+        collective arguments like ``path``.
         """
         check_compression(compression)
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
-        base_path, fingerprint = _collate_incremental_args(
-            coordinator, _resolve_base_arg(base), fingerprint
+        base_path, fingerprint, chunks, codec = _collate_incremental_args(
+            coordinator, _resolve_base_arg(base), fingerprint, chunks, codec
         )
         _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
@@ -189,6 +204,8 @@ class Snapshot:
                     base_path=base_path,
                     fingerprint=fingerprint,
                     base_metadata=_reusable_base_metadata(base, base_path),
+                    chunks=chunks,
+                    codec=codec,
                 )
         finally:
             storage.close()
@@ -212,6 +229,8 @@ class Snapshot:
         stage: str = "auto",
         base: Optional[Any] = None,
         fingerprint: Optional[bool] = None,
+        chunks: Optional[bool] = None,
+        codec: Optional[Any] = None,
     ) -> "PendingSnapshot":
         """Take a snapshot with storage writes overlapped with training.
 
@@ -239,8 +258,8 @@ class Snapshot:
             )
         coordinator = get_coordinator(coord)
         path = cls._collate_path(coordinator, path)
-        base_path, fingerprint = _collate_incremental_args(
-            coordinator, _resolve_base_arg(base), fingerprint
+        base_path, fingerprint, chunks, codec = _collate_incremental_args(
+            coordinator, _resolve_base_arg(base), fingerprint, chunks, codec
         )
         _validate_base_path(base_path, path)
         storage = url_to_storage_plugin(path)
@@ -262,6 +281,8 @@ class Snapshot:
                     base_path=base_path,
                     fingerprint=fingerprint,
                     base_metadata=_reusable_base_metadata(base, base_path),
+                    chunks=chunks,
+                    codec=codec,
                 )
         except BaseException:
             storage.close()
@@ -284,12 +305,22 @@ class Snapshot:
         base_path: Optional[str] = None,
         fingerprint: Optional[bool] = None,
         base_metadata: Optional[SnapshotMetadata] = None,
+        chunks: Optional[bool] = None,
+        codec: Optional[Any] = None,
     ) -> Optional[SnapshotMetadata]:
         # Returns the merged metadata when this process holds it after
         # the commit (sync takes; all ranks on the KV route, rank 0 on
         # the storage route) so the caller can seed its handle's cache.
         app_state = dict(app_state)
         rank = coordinator.get_rank()
+        # Content-addressed chunk dedup (chunkstore.py). Collective
+        # (collated with base/fingerprint), so every rank derives the
+        # same base_paths namespace.
+        chunk_enabled = (
+            chunks
+            if chunks is not None
+            else env_int("TPUSNAPSHOT_CHUNKS", 0) != 0
+        )
         rng_key, rng_stateful = _pop_rng_state(app_state)
         rng_captured: Optional[Dict[str, Any]] = None
 
@@ -345,7 +376,9 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
-                eager_host_copy=background is None and base_path is None,
+                eager_host_copy=background is None
+                and base_path is None
+                and not chunk_enabled,
             )
 
         global_keys = _gather_keys(coordinator, sorted(app_state.keys()))
@@ -360,7 +393,9 @@ class Snapshot:
                 manifest_out=manifest,
                 write_reqs_out=pending_write_reqs,
                 compression=compression,
-                eager_host_copy=background is None and base_path is None,
+                eager_host_copy=background is None
+                and base_path is None
+                and not chunk_enabled,
             )
             coordinator.barrier()
 
@@ -398,144 +433,201 @@ class Snapshot:
             # Manifest-churn note for the flight summary: the ledger
             # aggregates these per-rank blocks into the take digest's
             # added/unchanged/removed bytes + incremental efficiency.
-            recorder.note(
-                churn=inc_stats.churn_note(base_path is not None)
-            )
-            if background is None and base_path is not None:
-                # Sync takes suppressed prepare-time eager D2H copies so
-                # dedup hits never pay the transfer; start them now for
-                # the payloads that WILL be written.
-                for wr in pending_write_reqs:
-                    stager = wr.buffer_stager
-                    if isinstance(stager, ArrayBufferStager):
-                        stager.kickoff_host_copy()
+            churn_note = inc_stats.churn_note(base_path is not None)
+            recorder.note(churn=churn_note)
         else:
             # Full take without a fingerprint pass: everything written
             # is "added"; basis=full tells timeline the efficiency is
             # structural, not a measured dedup miss.
             from .incremental import IncrementalStats
 
-            recorder.note(churn=IncrementalStats().churn_note(False))
+            churn_note = IncrementalStats().churn_note(False)
+            recorder.note(churn=churn_note)
+
+        # Content-addressed chunk pass (chunkstore.py): split surviving
+        # array payloads into fixed-size chunks, fingerprint them on
+        # device, and drop every chunk the run's shared store already
+        # holds — sub-leaf dedup with no base= argument. Runs AFTER the
+        # leaf-granular incremental pass (a leaf hit is cheaper than N
+        # chunk hits) and BEFORE staging/cloning, so a chunk hit skips
+        # the device→host transfer too. Collective-free; the store ref
+        # in base_paths is a pure function of the collated path.
+        chunk_ctx = None
+        if chunk_enabled:
+            from . import chunkstore
+
+            watch.set_phase("chunk")
+            with recorder.phase("chunk"), tracing.span(
+                "Snapshot.chunkstore", path=path
+            ):
+                chunk_ctx = chunkstore.apply_chunkstore(
+                    manifest,
+                    pending_write_reqs,
+                    rank=rank,
+                    own_path=path,
+                    base_paths=base_paths_meta,
+                    codec_spec=codec,
+                )
+        if background is None and (
+            base_path is not None or chunk_enabled
+        ):
+            # Sync takes suppressed prepare-time eager D2H copies so a
+            # dedup hit (leaf- or chunk-granular) never pays the
+            # transfer; start them now for payloads that WILL be
+            # written whole (chunk stagers device-slice their own
+            # ranges and skip the whole-array prefetch). Keyed on
+            # chunk_ENABLED, not the context: a degraded chunk pass
+            # (unusable store) leaves plain stagers that still want
+            # their prefetch back.
+            for wr in pending_write_reqs:
+                stager = wr.buffer_stager
+                if isinstance(stager, ArrayBufferStager):
+                    stager.kickoff_host_copy()
 
         budget = get_process_memory_budget_bytes(coordinator)
         merged_metadata: Optional[SnapshotMetadata] = None
 
         if background is None:
-            write_stats: Dict[str, Any] = {}
-            watch.set_phase("write")
-            with recorder.phase("write"):
-                asyncio.run(
-                    execute_write_reqs(
-                        pending_write_reqs,
-                        storage,
-                        budget,
-                        rank,
-                        stats=write_stats,
-                        progress=watch,
-                    )
-                )
-            recorder.note_pipeline(write_stats)
-            watch.set_phase("commit")
-            # Route the manifest transport by size. The decision must be
-            # identical on every rank (divergent routes deadlock: some
-            # ranks would block in the KV all-gather, others in marker
-            # polling), so BOTH inputs are made collective: sizes are
-            # gathered, and rank 0's threshold is authoritative — env
-            # overrides propagated to only some hosts must not split the
-            # decision. Rank 0's take_id nonce rides the same gather (one
-            # collective round-trip instead of a broadcast + gather).
-            import pickle as _pickle
-
-            local_manifest_bytes = len(_pickle.dumps(manifest, protocol=4))
-            gathered = coordinator.all_gather_object(
-                (
-                    local_manifest_bytes,
-                    _commit_via_storage_threshold(),
-                    uuid.uuid4().hex if rank == 0 else None,
-                )
-            )
-            max_manifest_bytes = max(size for size, _, _ in gathered)
-            threshold = gathered[0][1]
-            take_id = gathered[0][2]
-            if (
-                coordinator.get_world_size() > 1
-                and max_manifest_bytes > threshold
-            ):
-                # Large manifests (7B-FSDP scale) commit through storage
-                # markers — O(world) storage ops instead of an O(world^2)
-                # KV all-gather (see _acommit_via_storage). Marker
-                # collection doubles as the completion barrier: rank 0
-                # sees every marker only after every rank's writes
-                # finished, preserving metadata-last ordering. The final
-                # barrier holds every rank until rank 0's metadata write
-                # (its barrier key is set only after asyncio.run returns).
-                # Flight summaries ride per-rank storage objects on this
-                # route (the same transport as the manifests).
-                with recorder.phase("commit"):
-                    merged_metadata = asyncio.run(
-                        _acommit_via_storage(
-                            storage,
+            try:
+                write_stats: Dict[str, Any] = {}
+                watch.set_phase("write")
+                with recorder.phase("write"):
+                    asyncio.run(
+                        execute_write_reqs(
+                            pending_write_reqs,
+                            # Chunk writes carry @chunkstore/ paths the
+                            # router sends to the shared store; every
+                            # other path passes through untouched.
+                            chunk_ctx.wrap(storage)
+                            if chunk_ctx is not None
+                            else storage,
+                            budget,
                             rank,
-                            coordinator.get_world_size(),
-                            manifest,
-                            take_id,
-                            base_paths=base_paths_meta,
-                            rank_summary=recorder.rank_summary(),
-                            kind="take",
-                            snapshot_path=path,
+                            stats=write_stats,
+                            progress=watch,
                         )
                     )
-            else:
-                # This route writes no per-rank storage marker, so it is
-                # each rank's last chance to settle deferred durability
-                # work (fs dirent fsyncs) BEFORE contributing to the
-                # gather below — rank 0 can publish metadata referencing
-                # this rank's objects the moment the gather completes.
-                storage.ensure_durable()
-                # The manifest all-gather doubles as the completion
-                # barrier: rank 0 holds every rank's manifest only after
-                # every rank finished its writes, so metadata-last
-                # ordering is guaranteed.
-                with recorder.phase("commit"):
-                    metadata = _gather_manifest(
-                        coordinator,
-                        manifest,
-                        take_id=take_id,
-                        base_paths=base_paths_meta,
+                recorder.note_pipeline(write_stats)
+                if chunk_ctx is not None:
+                    # Stored (post-codec) sizes exist only after the
+                    # writes: fold the chunk pass's accounting into the
+                    # churn note BEFORE any rank_summary serialization.
+                    chunk_ctx.stats.fold_into_churn(churn_note)
+                    recorder.note(churn=churn_note)
+                watch.set_phase("commit")
+                # Route the manifest transport by size. The decision must be
+                # identical on every rank (divergent routes deadlock: some
+                # ranks would block in the KV all-gather, others in marker
+                # polling), so BOTH inputs are made collective: sizes are
+                # gathered, and rank 0's threshold is authoritative — env
+                # overrides propagated to only some hosts must not split the
+                # decision. Rank 0's take_id nonce rides the same gather (one
+                # collective round-trip instead of a broadcast + gather).
+                import pickle as _pickle
+
+                local_manifest_bytes = len(_pickle.dumps(manifest, protocol=4))
+                gathered = coordinator.all_gather_object(
+                    (
+                        local_manifest_bytes,
+                        _commit_via_storage_threshold(),
+                        uuid.uuid4().hex if rank == 0 else None,
+                    )
+                )
+                max_manifest_bytes = max(size for size, _, _ in gathered)
+                threshold = gathered[0][1]
+                take_id = gathered[0][2]
+                if (
+                    coordinator.get_world_size() > 1
+                    and max_manifest_bytes > threshold
+                ):
+                    # Large manifests (7B-FSDP scale) commit through storage
+                    # markers — O(world) storage ops instead of an O(world^2)
+                    # KV all-gather (see _acommit_via_storage). Marker
+                    # collection doubles as the completion barrier: rank 0
+                    # sees every marker only after every rank's writes
+                    # finished, preserving metadata-last ordering. The final
+                    # barrier holds every rank until rank 0's metadata write
+                    # (its barrier key is set only after asyncio.run returns).
+                    # Flight summaries ride per-rank storage objects on this
+                    # route (the same transport as the manifests).
+                    with recorder.phase("commit"):
+                        merged_metadata = asyncio.run(
+                            _acommit_via_storage(
+                                storage,
+                                rank,
+                                coordinator.get_world_size(),
+                                manifest,
+                                take_id,
+                                base_paths=base_paths_meta,
+                                rank_summary=recorder.rank_summary(),
+                                kind="take",
+                                snapshot_path=path,
+                            )
+                        )
+                else:
+                    # This route writes no per-rank storage marker, so it is
+                    # each rank's last chance to settle deferred durability
+                    # work (fs dirent fsyncs) BEFORE contributing to the
+                    # gather below — rank 0 can publish metadata referencing
+                    # this rank's objects the moment the gather completes.
+                    storage.ensure_durable()
+                    # The manifest all-gather doubles as the completion
+                    # barrier: rank 0 holds every rank's manifest only after
+                    # every rank finished its writes, so metadata-last
+                    # ordering is guaranteed.
+                    with recorder.phase("commit"):
+                        metadata = _gather_manifest(
+                            coordinator,
+                            manifest,
+                            take_id=take_id,
+                            base_paths=base_paths_meta,
+                        )
+                        if rank == 0:
+                            # Chunk-ref doc BEFORE the commit point: a
+                            # committed manifest must always be
+                            # protected from chunk GC by its ref
+                            # (chunkstore.py). Correctness-bearing —
+                            # a failure here aborts the take.
+                            _write_chunk_refs(path, metadata)
+                            _write_snapshot_metadata(storage, metadata)
+                    # Flight summaries ride the coordinator on this route
+                    # (they are kilobytes, like everything else on it). The
+                    # gather is unconditional — every rank must issue the
+                    # identical collective sequence.
+                    summaries = coordinator.all_gather_object(
+                        recorder.rank_summary()
                     )
                     if rank == 0:
-                        _write_snapshot_metadata(storage, metadata)
-                # Flight summaries ride the coordinator on this route
-                # (they are kilobytes, like everything else on it). The
-                # gather is unconditional — every rank must issue the
-                # identical collective sequence.
-                summaries = coordinator.all_gather_object(
-                    recorder.rank_summary()
-                )
-                if rank == 0:
-                    report = flight.build_report(
-                        "take",
-                        path,
-                        take_id,
-                        coordinator.get_world_size(),
-                        summaries,
-                    )
-                    _write_report_best_effort(storage, report)
-                    # The committed take's digest lands in the durable
-                    # cross-take ledger (telemetry/ledger.py) — rank 0
-                    # only, after the metadata commit, best-effort.
-                    _ledger_append_best_effort(path, report)
-                # The all-gather gave EVERY rank the merged view; the
-                # caller seeds its handle's cache with it.
-                merged_metadata = metadata
-            # Rank 0 holds this barrier until its metadata write (and, on
-            # the storage route, the O(world) marker collection under
-            # _COMPLETION_TIMEOUT_S) finishes — which can legitimately
-            # exceed the coordinator's default store timeout at scale, so
-            # the barrier must wait at least as long (ADVICE r3).
-            barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
-            watch.finish()
-            flight.local_export(recorder)
+                        report = flight.build_report(
+                            "take",
+                            path,
+                            take_id,
+                            coordinator.get_world_size(),
+                            summaries,
+                        )
+                        _write_report_best_effort(storage, report)
+                        # The committed take's digest lands in the durable
+                        # cross-take ledger (telemetry/ledger.py) — rank 0
+                        # only, after the metadata commit, best-effort.
+                        _ledger_append_best_effort(path, report)
+                    # The all-gather gave EVERY rank the merged view; the
+                    # caller seeds its handle's cache with it.
+                    merged_metadata = metadata
+                # Rank 0 holds this barrier until its metadata write (and, on
+                # the storage route, the O(world) marker collection under
+                # _COMPLETION_TIMEOUT_S) finishes — which can legitimately
+                # exceed the coordinator's default store timeout at scale, so
+                # the barrier must wait at least as long (ADVICE r3).
+                barrier_compat(coordinator, _COMPLETION_TIMEOUT_S)
+                watch.finish()
+                flight.local_export(recorder)
+            finally:
+                # Chunk-store teardown (intent removal + plugin
+                # close) runs on success AND failure: a failed
+                # take's intent would otherwise defer chunk GC
+                # until it ages out.
+                if chunk_ctx is not None:
+                    chunk_ctx.cleanup()
         else:
             # Async take. All *collectives* run in the foreground (they are
             # kilobytes over the KV store); storage writes and the manifest
@@ -551,13 +643,20 @@ class Snapshot:
             # jit buffer donation (the next training step deletes the
             # snapshotted buffers).
             watch.set_phase("prestage")
-            with recorder.phase("prestage"):
-                _prestage_write_reqs(
-                    pending_write_reqs,
-                    budget,
-                    stage=stage,
-                    coordinator=coordinator,
-                )
+            try:
+                with recorder.phase("prestage"):
+                    _prestage_write_reqs(
+                        pending_write_reqs,
+                        budget,
+                        stage=stage,
+                        coordinator=coordinator,
+                    )
+            except BaseException:
+                # Failures before the drain thread exists must still
+                # tear down the chunk-store context.
+                if chunk_ctx is not None:
+                    chunk_ctx.cleanup()
+                raise
 
             # Per-take nonce: completion markers and the metadata document
             # from concurrent/previous takes to the same path must never
@@ -583,7 +682,11 @@ class Snapshot:
                     drain_t0 = time.monotonic()
                     await execute_write_reqs(
                         pending_write_reqs,
-                        storage,
+                        # Chunk writes route to the shared store (see
+                        # the sync branch).
+                        chunk_ctx.wrap(storage)
+                        if chunk_ctx is not None
+                        else storage,
                         budget,
                         rank,
                         stats=write_stats,
@@ -593,6 +696,12 @@ class Snapshot:
                         "write", time.monotonic() - drain_t0
                     )
                     recorder.note_pipeline(write_stats)
+                    if chunk_ctx is not None:
+                        # Stored sizes exist only post-write; fold the
+                        # chunk accounting in before the rank summary
+                        # serializes into the completion marker path.
+                        chunk_ctx.stats.fold_into_churn(churn_note)
+                        recorder.note(churn=churn_note)
                     background.phase = "commit markers"
                     watch.set_phase("commit")
                     await watch.async_tick(force=True)
@@ -620,9 +729,22 @@ class Snapshot:
                     watch.finish()
                     flight.local_export(recorder)
 
-                asyncio.run(_run())
+                try:
+                    asyncio.run(_run())
+                finally:
+                    # Drop this rank's chunk-store intent + close the
+                    # store plugin on success AND failure (a crashed
+                    # drain's intent would otherwise defer chunk GC
+                    # until it ages out).
+                    if chunk_ctx is not None:
+                        chunk_ctx.cleanup()
 
-            background.start(_drain)
+            try:
+                background.start(_drain)
+            except BaseException:
+                if chunk_ctx is not None:
+                    chunk_ctx.cleanup()
+                raise
 
         # Re-load the captured RNG state: the snapshot and the continuing
         # program observe identical RNG streams (reference
@@ -1085,6 +1207,24 @@ class Snapshot:
                     asyncio.run(_gc_backlinks_in_bases(metadata, self.path))
                 except Exception as e:
                     logger.warning(f"back-link marker GC failed: {e!r}")
+            # Content-chunk GC (chunkstore.py): the refcount decrement
+            # (drop our ref doc) + conditional free of chunks no other
+            # live ref lists. Ordering is safe by construction — the
+            # metadata (commit point) is already gone, so a crash at
+            # ANY boundary in here leaks at most; chunks referenced by
+            # committed manifests are protected by their ref docs.
+            # reconcile() re-drives an interrupted pass.
+            if metadata is not None:
+                try:
+                    from . import chunkstore
+
+                    if chunkstore.manifest_has_chunks(metadata.manifest):
+                        chunkstore.gc_snapshot_chunks(self.path, metadata)
+                except Exception as e:
+                    logger.warning(
+                        f"chunk-store GC failed: {e!r} (reconcile "
+                        f"re-drives it)"
+                    )
             # The handle must not keep serving the deleted snapshot's
             # manifest from its memo: a later read_object/restore must
             # see storage truth (not-found, or a re-taken snapshot).
@@ -1176,7 +1316,23 @@ class Snapshot:
         try:
             metadata = self._read_snapshot_metadata(src)
             by_loc: Dict[str, Any] = {}
+            # Content-chunked entries MATERIALIZE: their chunks are
+            # read from the shared store, decoded (codec) and
+            # content-verified, and the assembled payload lands at the
+            # entry's natural location — the copy is self-contained
+            # and restores through the plain path. Keyed by natural
+            # location (shared-chunk leaves still copy one payload
+            # each).
+            chunked_by_natural: Dict[str, Any] = {}
+            materialized_checksums: Dict[str, str] = {}
             for entry in _iter_payload_entries(metadata.manifest):
+                if getattr(entry, "chunks", None):
+                    parsed = parse_ref_location(entry.location)
+                    natural = (
+                        entry.location if parsed is None else parsed[1]
+                    )
+                    chunked_by_natural.setdefault(natural, entry)
+                    continue
                 seen = by_loc.get(entry.location)
                 # Replicated payloads appear once per rank and only the
                 # stripe owner's entry carries a checksum — keep the
@@ -1261,25 +1417,87 @@ class Snapshot:
                             in_flight -= est
                             gate.notify_all()
 
+                async def _one_chunked(natural: str, entry: Any) -> None:
+                    nonlocal in_flight
+                    from .chunkstore import (
+                        chunk_object_path,
+                        decode_and_verify_chunk,
+                    )
+                    from .serialization import compute_checksum
+
+                    est = sum(int(r["n"]) for r in entry.chunks)
+                    async with gate:
+                        await gate.wait_for(
+                            lambda: in_flight == 0
+                            or in_flight + est <= budget
+                        )
+                        in_flight += est
+                    try:
+                        parts = []
+                        base_idx = getattr(entry, "base", None)
+                        for rec in entry.chunks:
+                            loc = chunk_object_path(rec["k"])
+                            if base_idx is not None:
+                                loc = make_ref_location(base_idx, loc)
+                            async with sem:
+                                io_req = IOReq(path=loc)
+                                await src.read(io_req)
+                            # Decode + content verification always run
+                            # (materialization needs the decode anyway;
+                            # the fingerprint/frame check rides along).
+                            parts.append(
+                                decode_and_verify_chunk(
+                                    rec,
+                                    entry.dtype,
+                                    bytes(io_payload(io_req)),
+                                )
+                            )
+                        payload = b"".join(parts)
+                        materialized_checksums[natural] = (
+                            compute_checksum(payload)
+                        )
+                        async with sem:
+                            await dst.write(
+                                IOReq(path=natural, data=payload)
+                            )
+                    finally:
+                        async with gate:
+                            in_flight -= est
+                            gate.notify_all()
+
                 await asyncio.gather(
-                    *(_one(loc, e) for loc, e in by_loc.items())
+                    *(_one(loc, e) for loc, e in by_loc.items()),
+                    *(
+                        _one_chunked(nat, e)
+                        for nat, e in chunked_by_natural.items()
+                    ),
                 )
 
             asyncio.run(_copy_all())
             # The destination is SELF-CONTAINED: borrowed payloads were
             # materialized above, so its metadata must not carry base
-            # references. Rewrite a round-tripped copy (never mutate the
-            # cached metadata this handle keeps using).
+            # references or chunk records. Rewrite a round-tripped copy
+            # (never mutate the cached metadata this handle keeps
+            # using). The walk covers EVERY entry — replicated mirrors
+            # included (after the round-trip each rank's mirror is its
+            # own object, and a surviving chunked mirror would resolve
+            # against the emptied base_paths and break restore).
             dest_metadata = metadata
             if metadata.base_paths:
                 dest_metadata = SnapshotMetadata.from_yaml(metadata.to_yaml())
                 dest_metadata.base_paths = []
-                for e in _iter_payload_entries(dest_metadata.manifest):
+                for e in _walk_all_payload_entries(dest_metadata.manifest):
                     parsed = parse_ref_location(e.location)
                     if parsed is not None:
                         e.location = parsed[1]
                     if getattr(e, "base", None) is not None:
                         e.base = None
+                    if getattr(e, "chunks", None):
+                        e.chunks = None
+                        e.compression = None
+                        e.checksum = materialized_checksums.get(
+                            e.location, e.checksum
+                        )
             _write_snapshot_metadata(dst, dest_metadata)
         finally:
             src.close()
@@ -1334,7 +1552,30 @@ class Snapshot:
             # first-seen tuple would silently skip the available checksum
             # for most replicated paths.
             by_location: Dict[str, Tuple[Optional[str], Optional[int]]] = {}
+            # Content-chunked entries (chunkstore.py) scrub per CHUNK
+            # OBJECT — the entry's own location was never written. Each
+            # chunk decodes and content-verifies through the same
+            # helper the restore pipeline uses.
+            chunk_targets: Dict[str, Tuple[Dict[str, Any], str]] = {}
             for a in _iter_payload_entries(metadata.manifest):
+                recs = getattr(a, "chunks", None)
+                if recs:
+                    from .chunkstore import chunk_object_path
+
+                    base_idx = getattr(a, "base", None)
+                    for rec in recs:
+                        loc = chunk_object_path(rec["k"])
+                        if base_idx is not None:
+                            loc = make_ref_location(base_idx, loc)
+                        known_rec = chunk_targets.get(loc)
+                        # Prefer the record carrying stored-size/crc
+                        # (the writing take's) over a bare reference.
+                        if known_rec is None or (
+                            known_rec[0].get("cs") is None
+                            and rec.get("cs") is not None
+                        ):
+                            chunk_targets[loc] = (rec, a.dtype)
+                    continue
                 checksum = getattr(a, "checksum", None)
                 known = by_location.get(a.location)
                 if known is None or (checksum and not known[0]):
@@ -1485,8 +1726,29 @@ class Snapshot:
                     except Exception as e:
                         problems[loc] = str(e)
 
+                async def _one_chunk(loc, rec, dtype_name):
+                    from .chunkstore import decode_and_verify_chunk
+
+                    async with sem:
+                        io_req = IOReq(path=loc)
+                        try:
+                            await storage.read(io_req)
+                        except Exception as e:
+                            problems[loc] = f"unreadable: {e!r}"
+                            return
+                    try:
+                        decode_and_verify_chunk(
+                            rec, dtype_name, bytes(io_payload(io_req))
+                        )
+                    except Exception as e:
+                        problems[loc] = str(e)
+
                 await asyncio.gather(
-                    *(_one(*target) for target in targets)
+                    *(_one(*target) for target in targets),
+                    *(
+                        _one_chunk(loc, rec, dt)
+                        for loc, (rec, dt) in chunk_targets.items()
+                    ),
                 )
 
             asyncio.run(_scrub())
@@ -1826,24 +2088,26 @@ def _collate_incremental_args(
     coordinator: Coordinator,
     base_path: Optional[Any],
     fingerprint: Optional[bool],
-) -> Tuple[Optional[str], Optional[bool]]:
-    """Make ``base``/``fingerprint`` collective like ``path``: rank 0's
-    values are authoritative. Divergence is a real hazard, not a
-    nicety — entry ``base`` indices resolve against the MERGED
-    metadata's base_paths (rank 0's namespace), so a rank deduping
-    against a different base would commit references that resolve to
-    the wrong snapshot's bytes. Ranks passing ``BASE_FROM_RANK0`` (with
-    or without a hint) opted into rank 0's answer by protocol — no
-    warning."""
+    chunks: Optional[bool] = None,
+    codec: Optional[Any] = None,
+) -> Tuple[Optional[str], Optional[bool], Optional[bool], Optional[Any]]:
+    """Make ``base``/``fingerprint``/``chunks``/``codec`` collective
+    like ``path``: rank 0's values are authoritative. Divergence is a
+    real hazard, not a nicety — entry ``base`` indices resolve against
+    the MERGED metadata's base_paths (rank 0's namespace), so a rank
+    deduping against a different base (or chunking when its peers do
+    not) would commit references that resolve to the wrong snapshot's
+    bytes. Ranks passing ``BASE_FROM_RANK0`` (with or without a hint)
+    opted into rank 0's answer by protocol — no warning."""
     deferred = isinstance(base_path, _BaseFromRank0)
-    local = (None if deferred else base_path, fingerprint)
+    local = (None if deferred else base_path, fingerprint, chunks, codec)
     collated = coordinator.broadcast_object(local, src=0)
     if not deferred and collated != local:
         logger.warning(
             f"Rank {coordinator.get_rank()} passed "
-            f"(base={local[0]!r}, fingerprint={local[1]!r}) but rank 0 "
-            f"passed (base={collated[0]!r}, fingerprint={collated[1]!r}). "
-            f"Using rank 0's."
+            f"(base={local[0]!r}, fingerprint={local[1]!r}, "
+            f"chunks={local[2]!r}, codec={local[3]!r}) but rank 0 "
+            f"passed {collated!r}. Using rank 0's."
         )
     return collated
 
@@ -2151,9 +2415,19 @@ async def _gc_backlinks_in_bases(
     in its base snapshots' roots."""
     from .incremental import referencing_snapshots
 
+    from .chunkstore import STORE_DIRNAME
+
     own = own_path.rstrip("/")
     for ref in metadata.base_paths:
         root = resolve_base_ref(ref, own_path)
+        if root.rstrip("/").endswith(f"/{STORE_DIRNAME}"):
+            # The chunk store's base_paths entry is not a base
+            # SNAPSHOT: its refs/ docs are chunk-GC state owned by
+            # chunkstore.gc_snapshot_chunks (which delete() invokes
+            # right after this), not back-link markers — sweeping them
+            # here would both waste O(live snapshots) reads and remove
+            # the ref doc outside the GC's documented ordering.
+            continue
         base_storage = url_to_storage_plugin(root)
         try:
             for marker_path, ref_url in await referencing_snapshots(
@@ -2169,6 +2443,19 @@ async def _gc_backlinks_in_bases(
 
 # Canonical classifier lives in io_types (shared with the retry layer).
 _is_not_found_error = is_not_found_error
+
+
+def _walk_all_payload_entries(manifest: Manifest):
+    """EVERY payload-describing entry — including each replicated
+    mirror and every shard's ArrayEntry, with no canonicalization.
+    For in-place rewrites (copy_to's self-containment pass) that must
+    not leave a stale mirror behind; read-side callers want
+    :func:`_iter_payload_entries` instead."""
+    for entry in manifest.values():
+        if isinstance(entry, ShardedArrayEntry):
+            yield from (shard.array for shard in entry.shards)
+        elif getattr(entry, "location", None):
+            yield entry
 
 
 def _iter_payload_entries(manifest: Manifest):
@@ -2670,6 +2957,8 @@ def _verify_restored_fingerprints(
         resolve_fingerprints,
     )
 
+    from .chunkstore import entry_is_lossy
+
     pending: List[Tuple[str, str, Any]] = []
     skipped = 0
     for path, entry, value in jobs:
@@ -2680,10 +2969,18 @@ def _verify_restored_fingerprints(
                         slice(o, o + s)
                         for o, s in zip(sh.offsets, sh.sizes)
                     ),
-                    sh.array.fingerprint,
+                    # Lossy-coded chunk-stored shards legitimately
+                    # restore to different bytes than the recorded
+                    # fingerprint (int8 dequantization) — skip, like
+                    # fingerprint-less entries.
+                    None
+                    if entry_is_lossy(sh.array)
+                    else sh.array.fingerprint,
                 )
                 for sh in entry.shards
             ]
+        elif entry_is_lossy(entry):
+            specs = [(None, None)]
         else:
             specs = [(None, entry.fingerprint)]
         data = value
@@ -2787,13 +3084,15 @@ def _verify_restored_fingerprints(
 
 
 def _entry_has_checksum(entry: Entry) -> bool:
-    """Whether this entry records integrity tags for its stored bytes —
-    a dense/object entry's own checksum, or (chunked dense) any shard's.
-    Only the stripe owner of a replicated value stages bytes, so only
-    its entry carries checksums."""
-    if isinstance(entry, ShardedArrayEntry):
-        return any(s.array.checksum is not None for s in entry.shards)
-    return getattr(entry, "checksum", None) is not None
+    """Whether this entry PROVES stored content — a payload checksum,
+    or content-chunk records (chunk-stored payloads record integrity
+    per chunk instead of a whole-object checksum). Only the stripe
+    owner of a replicated value stages bytes, so only its entry
+    carries either. Delegates to manifest.entry_has_content so every
+    preference site (merge, available-entries, verify, copy) agrees."""
+    from .manifest import entry_has_content
+
+    return entry_has_content(entry)
 
 
 def _merge_manifests(all_manifests: List[Manifest]) -> Manifest:
@@ -2933,6 +3232,8 @@ async def _acommit_via_storage(
             take_id=take_id,
             base_paths=list(base_paths or []),
         )
+        # Chunk-ref doc BEFORE the commit point (see _awrite_chunk_refs).
+        await _awrite_chunk_refs(snapshot_path, metadata)
         await _awrite_snapshot_metadata(storage, metadata)
         # Progress objects are cleaned AT commit, and this sweep is the
         # ONLY deletion path: every rank's writes finished (their
@@ -3023,6 +3324,24 @@ async def _awrite_snapshot_metadata(
 
 def _write_snapshot_metadata(storage: StoragePlugin, metadata: SnapshotMetadata) -> None:
     asyncio.run(_awrite_snapshot_metadata(storage, metadata))
+
+
+async def _awrite_chunk_refs(
+    snapshot_path: str, metadata: SnapshotMetadata
+) -> None:
+    """Durably record the merged manifest's chunk-store references
+    BEFORE the metadata commit (rank 0, both commit routes) — the GC
+    anchor that makes a committed manifest's chunks unfreeable
+    (chunkstore.py). No-op for manifests without chunk entries;
+    correctness-bearing (NOT best-effort) when they exist."""
+    from . import chunkstore
+
+    if chunkstore.manifest_has_chunks(metadata.manifest):
+        await chunkstore.awrite_ref_for(snapshot_path, metadata)
+
+
+def _write_chunk_refs(snapshot_path: str, metadata: SnapshotMetadata) -> None:
+    asyncio.run(_awrite_chunk_refs(snapshot_path, metadata))
 
 
 def _ledger_append_best_effort(

@@ -44,6 +44,12 @@ budget                    the report by the hot tier's drain) exceeded
                           (telemetry/slo.py) fires the same rule id
                           LIVE from sampler state, before the
                           watermark exists to prove it post-hoc.
+dedup-ineffective         a chunked take's chunk-level dedup saved no
+                          more bytes than leaf-level dedup would have
+                          (every hit byte sat inside a fully-clean
+                          leaf) over >= TPUSNAPSHOT_DEDUP_MIN_BYTES of
+                          chunked payload — chunk-grid overhead
+                          without sub-leaf savings (chunkstore.py)
 ========================  =============================================
 
 Findings are observability, not judgment: every rule errs toward
@@ -575,6 +581,70 @@ def _rule_read_plane_degraded(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+# Chunking must have covered at least this much logical payload before
+# the dedup-ineffective verdict means anything (a 2 MiB toy take proves
+# nothing about chunk-grid fit).
+_DEDUP_MIN_LOGICAL_BYTES = 32 << 20
+
+
+def _rule_dedup_ineffective(report: Dict[str, Any]) -> Optional[Finding]:
+    """Chunk-granular dedup (chunkstore.py) is pure overhead when every
+    saved byte would have been saved by LEAF-granular dedup anyway:
+    chunk hits ≤ bytes of fully-clean leaves means sub-leaf
+    content-addressing bought nothing this take — the chunk grid does
+    not match the workload's dirty pattern (or the model is fully
+    clean/fully dirty)."""
+    notes = [
+        s.get("churn")
+        for s in _ranks(report)
+        if s.get("churn") and (
+            (s["churn"].get("chunk_hits") or 0)
+            + (s["churn"].get("chunk_misses") or 0)
+        )
+    ]
+    if not notes:
+        return None
+    logical = sum(int(c.get("chunk_logical_bytes") or 0) for c in notes)
+    hit = sum(int(c.get("chunk_hit_bytes") or 0) for c in notes)
+    clean = sum(int(c.get("leaf_clean_bytes") or 0) for c in notes)
+    misses = sum(int(c.get("chunk_misses") or 0) for c in notes)
+    floor = int(
+        env_float(
+            "TPUSNAPSHOT_DEDUP_MIN_BYTES", _DEDUP_MIN_LOGICAL_BYTES
+        )
+    )
+    if logical < floor or hit + clean == 0:
+        return None  # first take / thin evidence: silence
+    if hit > clean:
+        return None  # sub-leaf dedup saved bytes leaf dedup could not
+    return Finding(
+        rule="dedup-ineffective",
+        severity="warn",
+        title=(
+            f"chunk-granular dedup saved {hit / (1 << 20):.1f} MiB, all "
+            f"of it inside fully-clean leaves "
+            f"({clean / (1 << 20):.1f} MiB) — chunking overhead without "
+            f"sub-leaf savings"
+        ),
+        evidence={
+            "chunk_hit_bytes": hit,
+            "leaf_clean_bytes": clean,
+            "chunk_logical_bytes": logical,
+            "chunk_misses": misses,
+        },
+        remediation=(
+            "every deduplicated byte came from leaves that were "
+            "entirely unchanged — leaf-granular incremental takes "
+            "(base=/manager incremental mode) would have saved the "
+            "same bytes without per-chunk fingerprints, store lookups, "
+            "and manifest chunk records. If partially-dirty leaves "
+            "exist, shrink TPUSNAPSHOT_CHUNK_BYTES so the grid "
+            "resolves their dirty regions; otherwise disable chunking "
+            "(TPUSNAPSHOT_CHUNKS=0) for this workload."
+        ),
+    )
+
+
 RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_consume_dominated,
     _rule_read_dominated,
@@ -588,6 +658,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_missing_summary,
     _rule_hot_tier_degraded,
     _rule_read_plane_degraded,
+    _rule_dedup_ineffective,
 ]
 
 _SEVERITY_ORDER = {"critical": 0, "warn": 1}

@@ -66,8 +66,15 @@ source operation did not produce them::
       "retries": ..., "faults": ...,
       "phases": {"<phase>_s": max-across-ranks, ...},
       "goodput": {...} | null,           # goodput.snapshot() at commit
-      "churn": {"added_bytes", "unchanged_bytes", "removed_bytes",
-                "efficiency", "basis": "incremental" | "full"} | null,
+      "churn": {"added_bytes",           # LOGICAL bytes persisted anew
+                "unchanged_bytes",       # leaf- + chunk-dedup'd bytes
+                "removed_bytes",
+                "efficiency", "basis": "incremental" | "full",
+                "physical_bytes",        # bytes that HIT storage
+                                         # (post-dedup, post-codec)
+                "codec_ratio"            # stored/logical through the
+                                         # codec stage; null = no codec
+                } | null,
       "tier": {"hot_objects", "hot_bytes", "fallback_objects",
                "fallback_bytes", "degraded_peers": [host, ...]} | null,
                                          # hot-tier attribution (restores
@@ -479,20 +486,42 @@ def _churn_totals(
     noted = [s.get("churn") for s in summaries if s and s.get("churn")]
     if not noted:
         return None
-    unchanged = sum(int(c.get("unchanged_bytes", 0)) for c in noted)
-    removed = sum(int(c.get("removed_bytes", 0)) for c in noted)
+
+    def _sum(key: str) -> int:
+        return sum(int(c.get(key) or 0) for c in noted)
+
+    # Chunk-store accounting (chunkstore.py fold_into_churn): hit bytes
+    # count as unchanged; the LOGICAL added bytes replace the stored
+    # (post-codec) chunk bytes inside the pipeline's byte total, so
+    # `efficiency` keeps measuring byte-movement dedup while
+    # `physical_bytes` records what actually hit storage.
+    chunk_hit = _sum("chunk_hit_bytes")
+    chunk_stored = _sum("chunk_stored_bytes")
+    chunk_written_logical = _sum("chunk_written_logical_bytes")
+    codec_in = _sum("codec_in_bytes")
+    codec_out = _sum("codec_out_bytes")
+    unchanged = _sum("unchanged_bytes") + chunk_hit
+    removed = _sum("removed_bytes")
+    added_logical = added_bytes - chunk_stored + chunk_written_logical
     basis = (
         "incremental"
-        if any(c.get("basis") == "incremental" for c in noted)
+        if chunk_hit > 0
+        or any(c.get("basis") == "incremental" for c in noted)
         else "full"
     )
-    denom = added_bytes + unchanged
+    denom = added_logical + unchanged
     return {
-        "added_bytes": int(added_bytes),
+        "added_bytes": int(added_logical),
         "unchanged_bytes": unchanged,
         "removed_bytes": removed,
         "efficiency": round(unchanged / denom, 6) if denom > 0 else None,
         "basis": basis,
+        # Bytes that hit storage this take (post-dedup post-codec) and
+        # the codec's logical→stored ratio (None = no codec ran).
+        "physical_bytes": int(added_bytes),
+        "codec_ratio": (
+            round(codec_out / codec_in, 6) if codec_in > 0 else None
+        ),
     }
 
 

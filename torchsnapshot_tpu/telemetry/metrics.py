@@ -418,3 +418,20 @@ SNAPSERVE_REMOTE_READS = (
 SNAPSERVE_FALLBACKS = (
     "tpusnapshot_snapserve_fallbacks_total"  # counter {reason}
 )
+
+# Content-addressed chunk store (chunkstore.py) + codec stage
+# (codecs.py): chunk dedup outcomes, logical-vs-stored byte flow, and
+# GC activity. `result` on CHUNKSTORE_BYTES is "hit" (logical bytes a
+# present chunk saved) or "stored" (post-codec bytes actually written);
+# CODEC_BYTES `dir` is "in" (logical) / "out" (encoded) per codec.
+CHUNKSTORE_CHUNKS = (
+    "tpusnapshot_chunkstore_chunks_total"  # counter {result}
+)
+CHUNKSTORE_BYTES = (
+    "tpusnapshot_chunkstore_bytes_total"  # counter {result}
+)
+CHUNKSTORE_GC = (
+    "tpusnapshot_chunkstore_gc_objects_total"  # counter {action}
+)
+CODEC_BYTES = "tpusnapshot_codec_bytes_total"  # counter {dir,codec}
+CODEC_SECONDS = "tpusnapshot_codec_seconds_total"  # counter {op}

@@ -51,6 +51,11 @@ _TAKE_METRICS: List[_MetricDef] = [
     ("stall_pct", "budget stall %", "high", 10.0, None),
     ("retries", "storage retries", "high", 5.0, None),
     ("churn.efficiency", "incremental efficiency", "low", 0.1, 0.15),
+    # Codec stage (chunkstore.py): stored/logical bytes through the
+    # per-chunk codec — a RISING ratio means compression is buying
+    # less (codec misconfigured, payload entropy shifted). None (no
+    # codec ran) is missing data, never a regression.
+    ("churn.codec_ratio", "codec ratio", "high", 0.02, 0.2),
     # The WINDOWED fraction (since the previous ledger record, stamped
     # at append time): the cumulative fraction flattens as a run grows,
     # so late-run overhead creep would hide inside it.
@@ -90,6 +95,27 @@ _BENCH_METRICS: List[_MetricDef] = [
         0.15,
     ),
     ("read_fanout.served_gbps", "read-fanout GB/s", "low", 0.05, 0.3),
+    # Chunk-store dedup + codec headline numbers (bench dedup_codec
+    # section): the unchanged-retake physical fraction and the 10%-
+    # dirty-leaf physical fraction creeping UP mean dedup is saving
+    # fewer bytes; the effective (logical-bytes) throughput and codec
+    # ratio guard the "move fewer bytes" win itself.
+    (
+        "dedup_codec.second_take_physical_pct",
+        "2nd-take physical %",
+        "high",
+        0.5,
+        0.5,
+    ),
+    (
+        "dedup_codec.dirty10_physical_pct",
+        "10%-dirty physical %",
+        "high",
+        1.0,
+        0.5,
+    ),
+    ("dedup_codec.effective_gbps", "dedup effective GB/s", "low", 0.05, 0.3),
+    ("dedup_codec.codec_ratio", "bench codec ratio", "high", 0.02, 0.2),
 ]
 
 
