@@ -394,6 +394,22 @@ def _restore_trace_breakdown(trace_path: str) -> dict:
     return {n: (round(sums[n], 2), counts[n]) for n in sums}
 
 
+def _restore_consume_profile(snap_dir: str) -> dict:
+    """The consume_profile block from a just-written restore flight
+    report (snapxray): {substeps, consume_s, consume_gbps,
+    h2d_probe_gbps?, h2d_fraction?}. {} on any failure — the bench
+    headline never depends on observability."""
+    try:
+        with open(os.path.join(snap_dir, ".report.restore.json")) as f:
+            report = json.load(f)
+        for summary in report.get("ranks") or []:
+            if summary and summary.get("consume_profile"):
+                return summary["consume_profile"]
+    except Exception:
+        pass
+    return {}
+
+
 def _run_cpu_subprocess_bench(script_name: str, timeout_s: float = 600.0) -> dict:
     """Run a benchmarks/ script on the virtual CPU platform in a
     subprocess and parse its one-line JSON. Returns {"ok": False, ...}
@@ -1865,6 +1881,13 @@ def _bench_body(bench_dir: str) -> None:
                 ),
                 file=sys.stderr,
             )
+            # Consume sub-phase breakdown (snapxray): the restore's own
+            # flight report carries the micro-profiler block; surfacing
+            # it in the BENCH JSON is what lets bench_compare name a
+            # sub-phase shift across rounds.
+            consume_profile = _restore_consume_profile(
+                f"{bench_dir}/snap"
+            )
             # The CEILING is the better probe (same convention as the
             # D2H probe: interference only subtracts) — a mean could
             # report restore/ceiling above 1.0, which is meaningless.
@@ -1874,6 +1897,7 @@ def _bench_body(bench_dir: str) -> None:
                 spread,
                 spans,
                 _phase_verdict(trace_path),
+                consume_profile,
             )
 
         def _ratio(att):
@@ -1888,7 +1912,7 @@ def _bench_body(bench_dir: str) -> None:
         def _record_restore(attempts_so_far) -> None:
             # Incremental: a supervisor cut mid-retry still reports the
             # best completed attempt.
-            el, ceil, spread, spans, verdict = max(
+            el, ceil, spread, spans, verdict, consume_profile = max(
                 attempts_so_far, key=_ratio
             )
             r_gbps = restored_gib / el
@@ -1897,6 +1921,12 @@ def _bench_body(bench_dir: str) -> None:
                 {
                     "restore_GBps": round(r_gbps, 4),
                     "h2d_ceiling_GBps": round(ceil, 4),
+                    # The snapxray name for the same bracketed ceiling:
+                    # the restore report states consume GB/s as a
+                    # fraction of an H2D probe, and the BENCH JSON
+                    # carries the probe under the report's field name
+                    # so cross-artifact readers need one key.
+                    "h2d_probe_gbps": round(ceil, 4),
                     "h2d_probe_spread": round(spread, 2),
                     "restore_vs_ceiling": round(r_ratio, 3),
                     "restore_bytes": int(restored_gib * 1024**3),
@@ -1913,6 +1943,16 @@ def _bench_body(bench_dir: str) -> None:
                     ),
                 }
             )
+            if consume_profile:
+                _RESULTS["restore_consume_profile"] = consume_profile
+                c_gbps = consume_profile.get("consume_gbps")
+                if c_gbps:
+                    # Consume against the BRACKETED ceiling (tighter
+                    # than the report's one-shot probe): the fraction
+                    # ROADMAP item 1's rewrite must push toward 1.0.
+                    _RESULTS["restore_consume_vs_h2d"] = round(
+                        c_gbps / max(ceil, 1e-9), 4
+                    )
 
         attempts = [_timed_restore()]
         _record_restore(attempts)
@@ -1936,9 +1976,14 @@ def _bench_body(bench_dir: str) -> None:
             )
             attempts.append(_timed_restore())
             _record_restore(attempts)
-        restore_elapsed, h2d_gbps, h2d_spread, restore_spans, _verdict = max(
-            attempts, key=_ratio
-        )
+        (
+            restore_elapsed,
+            h2d_gbps,
+            h2d_spread,
+            restore_spans,
+            _verdict,
+            _consume_profile,
+        ) = max(attempts, key=_ratio)
         restore_gbps = restored_gib / restore_elapsed
         restore_vs_ceiling = restore_gbps / max(h2d_gbps, 1e-9)
         # A restore that still misses half its bracketed ceiling (or

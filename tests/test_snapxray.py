@@ -1,0 +1,783 @@
+"""snapxray: cross-process causal tracing + the restore consume
+micro-profiler (ISSUE 11).
+
+Pinned here:
+
+- take/restore roots stamp a contextvar trace id; every pipeline span
+  under the root carries it.
+- snapserve RPCs propagate the context in request frames: the server's
+  spans adopt the client's trace id and the client/server emit paired
+  Perfetto flow events (``s``/``t``/``f``) under one flow id.
+- A mid-restore server kill keeps the degraded direct reads under the
+  SAME trace id, with the transition visible as a
+  ``snapserve.degraded`` instant (satellite 3).
+- hottier replicate/drain/tierdown spans inherit the originating
+  take's trace id, however long after the ack the drain runs.
+- The restore flight report carries a consume sub-phase breakdown
+  whose in-consume sub-steps plus ``other`` sum to the consume wall
+  exactly, plus consume GB/s as a fraction of the H2D probe; the
+  ledger restore digest folds it; the doctor's
+  ``consume-dominated-restore`` rule names the dominant sub-step.
+- telemetry.merge accepts multi-PROCESS inputs (ranks + a server),
+  aligns a barrier-less server via paired flows, counts cross-process
+  flows, and names the gating process in the critical path.
+- Trace files are per-process: role/pid env suffixes, and a forked
+  child's flush can never clobber the parent's file.
+"""
+
+import json
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import RemoteSnapshot, Snapshot, StateDict
+from torchsnapshot_tpu import faultline as fl
+from torchsnapshot_tpu import hottier, snapserve, tracing
+from torchsnapshot_tpu.telemetry import consume_profile
+from torchsnapshot_tpu.telemetry import ledger as runledger
+from torchsnapshot_tpu.telemetry import merge, summarize
+from torchsnapshot_tpu.telemetry.doctor import diagnose_report
+
+
+# ----------------------------------------------------------------- helpers
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing_and_servers(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_SNAPSERVE_DOWN_COOLDOWN_S", "0.2")
+    tracing.disable()
+    yield
+    tracing.disable()
+    snapserve.kill_local_servers()
+
+
+def _mem_root(tag):
+    return f"memory://snapxray-{tag}-{uuid.uuid4().hex[:10]}/run"
+
+
+def _state(n_params=3, n=2048, seed=3):
+    rng = np.random.default_rng(seed)
+    return {
+        "m": StateDict(
+            **{
+                f"p{i}": rng.standard_normal(n).astype(np.float32)
+                for i in range(n_params)
+            }
+        )
+    }
+
+
+def _zero_like(state):
+    return {
+        "m": StateDict(
+            **{k: np.zeros_like(v) for k, v in state["m"].items()}
+        )
+    }
+
+
+def _assert_exact(target, state):
+    for k, v in state["m"].items():
+        np.testing.assert_array_equal(target["m"][k], v)
+
+
+def _flush_events(path):
+    tracing.flush()
+    with open(path) as f:
+        return json.load(f)["traceEvents"]
+
+
+def _spans(events, name):
+    return [
+        e for e in events if e.get("name") == name and e.get("ph") == "b"
+    ]
+
+
+def _trace_ids(events, name):
+    return {
+        (e.get("args") or {}).get("trace")
+        for e in _spans(events, name)
+    }
+
+
+def _restore_report(root):
+    import asyncio
+
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    from torchsnapshot_tpu.telemetry import report as flight
+
+    storage = url_to_storage_plugin(root)
+    try:
+        return asyncio.run(
+            flight.aread_json(storage, flight.RESTORE_REPORT_FNAME)
+        )
+    finally:
+        storage.close()
+
+
+# ----------------------------------------------------- trace-context roots
+
+
+def test_take_and_restore_roots_stamp_distinct_trace_ids(tmp_path):
+    trace_path = str(tmp_path / "t.json")
+    tracing.enable(trace_path)
+    root = _mem_root("roots")
+    state = _state()
+    Snapshot.take(root, state)
+    target = _zero_like(state)
+    Snapshot(root).restore(target)
+    events = _flush_events(trace_path)
+
+    take_traces = _trace_ids(events, "Snapshot.take")
+    restore_traces = _trace_ids(events, "Snapshot.restore")
+    assert len(take_traces) == 1 and None not in take_traces
+    assert len(restore_traces) == 1 and None not in restore_traces
+    (take_id,) = take_traces
+    (restore_id,) = restore_traces
+    assert take_id != restore_id
+    assert take_id.startswith("take-")
+    assert restore_id.startswith("restore-")
+    # Every pipeline span under a root carries that root's id.
+    for name in ("stage", "write"):
+        assert _trace_ids(events, name) == {take_id}
+    for name in ("read", "consume"):
+        assert _trace_ids(events, name) == {restore_id}
+
+
+def test_trace_context_cheap_and_absent_outside_roots():
+    assert tracing.current_trace_id() is None
+    with tracing.trace_scope("take") as tid:
+        assert tracing.current_trace_id() == tid
+        with tracing.adopt_trace("other-1"):
+            assert tracing.current_trace_id() == "other-1"
+        assert tracing.current_trace_id() == tid
+    assert tracing.current_trace_id() is None
+    # flow ids without tracing enabled AND without a scope: nothing to
+    # bind to, so no id is minted.
+    assert tracing.flow_start("x") is None
+    with tracing.trace_scope("restore"):
+        # Scope active but tracing off: the id still exists for the
+        # wire (a tracing-on server can bind to it).
+        assert tracing.flow_start("x") is not None
+
+
+# ----------------------------------------------- snapserve propagation
+
+
+def test_rpc_flow_events_and_server_spans_join_client_trace(tmp_path):
+    trace_path = str(tmp_path / "rpc.json")
+    root = _mem_root("rpc")
+    state = _state()
+    Snapshot.take(root, state)
+    server = snapserve.start_local_server()
+    try:
+        tracing.enable(trace_path)
+        target = _zero_like(state)
+        RemoteSnapshot(root, addr=server.addr).restore(target)
+        events = _flush_events(trace_path)
+    finally:
+        server.stop()
+    _assert_exact(target, state)
+
+    restore_traces = _trace_ids(events, "Snapshot.restore")
+    (restore_id,) = restore_traces
+    # Server spans adopted the client's trace id (in-process server:
+    # same trace file, same causal chain).
+    req_traces = _trace_ids(events, "snapserve.request")
+    assert req_traces == {restore_id}, req_traces
+    fetch_traces = _trace_ids(events, "snapserve.backend_fetch")
+    assert restore_id in fetch_traces
+    # Paired flow events under shared ids: s (client out) + t (server
+    # handling) + f (client response in).
+    flows = {}
+    for e in events:
+        if e.get("ph") in ("s", "t", "f"):
+            flows.setdefault(e["id"], set()).add(e["ph"])
+    full = [fid for fid, phs in flows.items() if {"s", "t", "f"} <= phs]
+    assert full, flows
+    assert all(restore_id in fid for fid in full)
+    # Cache events are visible server-side.
+    assert any(
+        e.get("name") in ("snapserve.cache_hit", "snapserve.cache_miss")
+        for e in events
+    )
+
+
+@pytest.mark.faultline
+def test_kill_server_keeps_trace_id_through_degraded_fallback(tmp_path):
+    """Satellite 3: a mid-restore server kill keeps the fallback direct
+    reads under the SAME trace id, with the degraded transition visible
+    as an instant."""
+    trace_path = str(tmp_path / "kill.json")
+    root = _mem_root("kill")
+    state = _state(n_params=6)
+    Snapshot.take(root, state)
+    server = snapserve.start_local_server()
+    remote = RemoteSnapshot(root, addr=server.addr)
+    sched = fl.FaultSchedule().kill_server(nth=3)
+    tracing.enable(trace_path)
+    with fl.inject(sched) as ctl:
+        target = _zero_like(state)
+        remote.restore(target)
+    events = _flush_events(trace_path)
+    _assert_exact(target, state)
+    assert ctl.fault_counts().get("killserver") == 1
+
+    (restore_id,) = _trace_ids(events, "Snapshot.restore")
+    # The transition instant, under the restore's trace.
+    degraded = [
+        e for e in events if e.get("name") == "snapserve.degraded"
+    ]
+    assert degraded, "no snapserve.degraded instant in the trace"
+    assert all(
+        (e.get("args") or {}).get("trace") == restore_id for e in degraded
+    )
+    # Every read span — served AND fallback-direct — is under the same
+    # trace id: one causal story across the degradation.
+    assert _trace_ids(events, "read") == {restore_id}
+    report = _restore_report(root)
+    planes = [s.get("read_plane") for s in report["ranks"] if s]
+    assert planes and planes[0]["fallback_objects"] > 0
+
+
+# ----------------------------------------------------- hottier inheritance
+
+
+@pytest.mark.faultline
+def test_hottier_drain_spans_inherit_take_trace(tmp_path):
+    trace_path = str(tmp_path / "tier.json")
+    tracing.enable(trace_path)
+    root = _mem_root("tier")
+    with hottier.hot_tier(rank=0, world=4, k=2, drain="manual"):
+        Snapshot.take(root, {"s": StateDict(w=jnp.ones((1024,)))})
+        events = _flush_events(trace_path)
+        (take_id,) = _trace_ids(events, "Snapshot.take")
+        replicate_traces = _trace_ids(events, "hottier.replicate")
+        assert replicate_traces == {take_id}, replicate_traces
+        # The drain runs long after the take returned, on the drain
+        # executor's own thread — its spans still carry the take's id.
+        hottier.drain_now()
+        events = _flush_events(trace_path)
+        assert _trace_ids(events, "hottier.drain") == {take_id}
+        assert _trace_ids(events, "hottier.tierdown") == {take_id}
+    hottier.reset_hot_tier()
+
+
+# ------------------------------------------------- consume micro-profiler
+
+
+def test_restore_report_carries_reconciling_consume_breakdown(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("TPUSNAPSHOT_H2D_PROBE_MIN_BYTES", "0")
+    monkeypatch.setenv("TPUSNAPSHOT_H2D_PROBE_BYTES", str(1 << 20))
+    root = _mem_root("prof")
+    state = _state(n_params=4)
+    Snapshot.take(root, state)
+    target = _zero_like(state)
+    Snapshot(root).restore(target)
+    _assert_exact(target, state)
+
+    report = _restore_report(root)
+    profile = next(
+        s["consume_profile"]
+        for s in report["ranks"]
+        if s and s.get("consume_profile")
+    )
+    substeps = profile["substeps"]
+    assert profile["bytes"] > 0
+    # Acceptance: the in-consume sub-steps (``other`` included) sum to
+    # the consume wall exactly; read_wait sits beside them.
+    in_consume = sum(
+        entry["seconds"]
+        for name, entry in substeps.items()
+        if name != "read_wait"
+    )
+    assert in_consume == pytest.approx(profile["consume_s"], abs=1e-3)
+    assert "read_wait" in substeps
+    # The H2D probe anchors consume GB/s against the hardware bound.
+    assert profile["h2d_probe_gbps"] > 0
+    assert profile["h2d_fraction"] == pytest.approx(
+        profile["consume_gbps"] / profile["h2d_probe_gbps"], rel=1e-3
+    )
+
+
+def test_small_restore_skips_h2d_probe(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_H2D_PROBE_MIN_BYTES", str(1 << 30))
+    root = _mem_root("noprobe")
+    state = _state(n_params=1, n=256)
+    Snapshot.take(root, state)
+    Snapshot(root).restore(_zero_like(state))
+    report = _restore_report(root)
+    profile = next(
+        (
+            s["consume_profile"]
+            for s in report["ranks"]
+            if s and s.get("consume_profile")
+        ),
+        None,
+    )
+    assert profile is not None
+    assert "h2d_probe_gbps" not in profile
+
+
+def test_compressed_restore_attributes_decode_seconds():
+    root = _mem_root("zlib")
+    state = _state(n_params=2, n=1 << 16)
+    Snapshot.take(root, state, compression="zlib")
+    Snapshot(root).restore(_zero_like(state))
+    report = _restore_report(root)
+    profile = next(
+        s["consume_profile"]
+        for s in report["ranks"]
+        if s and s.get("consume_profile")
+    )
+    assert profile["substeps"]["decode"]["seconds"] > 0
+    assert profile["substeps"]["decode"]["bytes"] > 0
+
+
+def test_chunked_restore_attributes_decode_and_verify():
+    root = _mem_root("chunks")
+    state = _state(n_params=2, n=1 << 16)
+    Snapshot.take(root, state, chunks=True, codec="zlib")
+    target = _zero_like(state)
+    Snapshot(root).restore(target)
+    _assert_exact(target, state)
+    report = _restore_report(root)
+    profile = next(
+        s["consume_profile"]
+        for s in report["ranks"]
+        if s and s.get("consume_profile")
+    )
+    # Chunk-store restores decode (codec) AND verify (content
+    # fingerprint) every chunk inside the consume executor.
+    assert profile["substeps"]["decode"]["seconds"] > 0
+    assert profile["substeps"]["verify"]["seconds"] > 0
+    assert profile["substeps"]["reassemble"]["bytes"] > 0
+
+
+def test_ledger_restore_digest_folds_consume_block(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_H2D_PROBE_MIN_BYTES", "0")
+    monkeypatch.setenv("TPUSNAPSHOT_H2D_PROBE_BYTES", str(1 << 20))
+    root = _mem_root("ledger")
+    state = _state()
+    Snapshot.take(root, state)
+    Snapshot(root).restore(_zero_like(state))
+    records, _ = runledger.read_records(root)
+    restores = [r for r in records if r["kind"] == "restore"]
+    assert restores, records
+    consume = restores[-1]["consume"]
+    assert consume is not None
+    assert consume["consume_s"] >= 0
+    assert set(consume["substeps"]) >= {"other"}
+    assert consume["h2d_fraction"] > 0
+
+
+def test_concurrent_restores_do_not_cross_attribute_profiles():
+    """Two restores in flight: each report's breakdown reflects only
+    its own traffic (contextvar scoping, as for read_plane)."""
+    import threading
+
+    roots = [_mem_root("conc-a"), _mem_root("conc-b")]
+    states = [_state(seed=1), _state(seed=2)]
+    for root, state in zip(roots, states):
+        Snapshot.take(root, state)
+    errors = []
+
+    def _restore(root, state):
+        try:
+            target = _zero_like(state)
+            Snapshot(root).restore(target)
+            _assert_exact(target, state)
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=_restore, args=(r, s))
+        for r, s in zip(roots, states)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for root in roots:
+        report = _restore_report(root)
+        profile = next(
+            s["consume_profile"]
+            for s in report["ranks"]
+            if s and s.get("consume_profile")
+        )
+        in_consume = sum(
+            e["seconds"]
+            for n, e in profile["substeps"].items()
+            if n != "read_wait"
+        )
+        # Cross-attribution would break the per-restore reconciliation
+        # (one report absorbing the other's sub-step seconds).
+        assert in_consume == pytest.approx(
+            profile["consume_s"], abs=1e-3
+        )
+
+
+# ------------------------------------------------------------- doctor rule
+
+
+def _synthetic_consume_report(dominant="decode"):
+    substeps = {
+        "decode": {"count": 10, "seconds": 2.0, "bytes": 1 << 30},
+        "verify": {"count": 10, "seconds": 1.0, "bytes": 1 << 30},
+        "device_put": {"count": 10, "seconds": 0.5, "bytes": 1 << 30},
+        "other": {"count": 0, "seconds": 0.5, "bytes": 0},
+        "read_wait": {"count": 10, "seconds": 99.0, "bytes": 0},
+    }
+    if dominant == "device_put":
+        substeps["device_put"]["seconds"] = 30.0
+    return {
+        "format_version": 1,
+        "kind": "restore",
+        "path": "memory://x",
+        "world_size": 1,
+        "ranks": [
+            {
+                "rank": 0,
+                "wall_s": 5.0,
+                "phases": {"read_s": 0.3, "consume_s": 4.0},
+                "bytes": 1 << 30,
+                "consume_profile": {
+                    "substeps": substeps,
+                    "consume_s": 4.0,
+                    "consume_gbps": 0.25,
+                    "h2d_probe_gbps": 2.5,
+                    "h2d_fraction": 0.1,
+                },
+            }
+        ],
+        "totals": {"bytes": 1 << 30, "wall_s": 5.0},
+    }
+
+
+def test_doctor_names_dominant_substep_with_specific_remediation():
+    findings = diagnose_report(_synthetic_consume_report())
+    finding = next(
+        f for f in findings if f.rule == "consume-dominated-restore"
+    )
+    assert finding.evidence["dominant_substep"] == "decode"
+    assert "decode" in finding.title
+    assert "zstd" in finding.remediation  # decode-specific advice
+    assert finding.evidence["consume_h2d_fraction"] == pytest.approx(0.1)
+    # read_wait is NOT an in-consume sub-step and must never be named
+    # dominant even when large.
+    assert "read_wait" not in finding.evidence["substeps_s"]
+
+    findings = diagnose_report(
+        _synthetic_consume_report(dominant="device_put")
+    )
+    finding = next(
+        f for f in findings if f.rule == "consume-dominated-restore"
+    )
+    assert finding.evidence["dominant_substep"] == "device_put"
+    assert "h2d_probe_gbps" in finding.remediation
+
+
+# ------------------------------------------------------- multi-process merge
+
+
+def _client_doc(epoch=1_700_000_000.0):
+    fid = "restore-abc/100.1"
+    return {
+        "traceEvents": [
+            {"name": "read", "cat": "snapshot", "ph": "b", "id": 1,
+             "ts": 0.0, "pid": 100, "tid": 1,
+             "args": {"trace": "restore-abc"}},
+            {"name": "read", "cat": "snapshot", "ph": "e", "id": 1,
+             "ts": 400_000.0, "pid": 100, "tid": 1},
+            {"name": "snapserve.rpc", "cat": "flow", "ph": "s",
+             "id": fid, "ts": 10_000.0, "pid": 100, "tid": 1},
+            {"name": "snapserve.rpc", "cat": "flow", "ph": "f",
+             "bp": "e", "id": fid, "ts": 210_000.0, "pid": 100,
+             "tid": 1},
+            {"name": "consume", "cat": "snapshot", "ph": "b", "id": 2,
+             "ts": 400_000.0, "pid": 100, "tid": 1},
+            {"name": "consume", "cat": "snapshot", "ph": "e", "id": 2,
+             "ts": 900_000.0, "pid": 100, "tid": 1},
+        ],
+        "metadata": {
+            "clock_epoch_s": epoch,
+            "rank": 0,
+            "host": "client-host",
+            "pid": 100,
+        },
+    }
+
+
+def _server_doc(epoch=1_700_000_000.0, skew_s=0.0):
+    fid = "restore-abc/100.1"
+    # True wall times sit inside the client's s/f bracket; the recorded
+    # epoch carries the injected skew.
+    return {
+        "traceEvents": [
+            {"name": "snapserve.rpc", "cat": "flow", "ph": "t",
+             "id": fid, "ts": 110_000.0, "pid": 999, "tid": 1},
+            {"name": "snapserve.request", "cat": "snapshot", "ph": "b",
+             "id": 1, "ts": 105_000.0, "pid": 999, "tid": 1,
+             "args": {"trace": "restore-abc"}},
+            {"name": "snapserve.request", "cat": "snapshot", "ph": "e",
+             "id": 1, "ts": 200_000.0, "pid": 999, "tid": 1},
+            {"name": "snapserve.backend_fetch", "cat": "snapshot",
+             "ph": "b", "id": 2, "ts": 120_000.0, "pid": 999, "tid": 1},
+            {"name": "snapserve.backend_fetch", "cat": "snapshot",
+             "ph": "e", "id": 2, "ts": 190_000.0, "pid": 999, "tid": 1},
+        ],
+        "metadata": {
+            "clock_epoch_s": epoch + skew_s,
+            "rank": 0,
+            "host": "server-host",
+            "pid": 999,
+            "role": "server",
+        },
+    }
+
+
+def test_merge_multiprocess_client_plus_server(tmp_path, capsys):
+    a = tmp_path / "client.json"
+    b = tmp_path / "server.json"
+    a.write_text(json.dumps(_client_doc()))
+    b.write_text(json.dumps(_server_doc()))
+    merged_path = str(tmp_path / "m.json")
+    assert (
+        merge.main([str(a), str(b), "-o", merged_path, "--json"]) == 0
+    )
+    info = json.loads(capsys.readouterr().out)
+    # A server doc with the same rank number is NOT a duplicate-rank
+    # error: it is a distinct process.
+    assert info["cross_process_flows"] >= 1
+    labels = {p["label"] for p in info["processes"]}
+    assert "rank 0 (client-host)" in labels
+    assert "server pid 999 (server-host)" in labels
+    # Critical path: the client's consume ends last (0.9s) — the gating
+    # process is the client, and the server's serving spans are in the
+    # per-process table.
+    cp = info["critical_path"]
+    assert cp["gating_process"] == "rank 0 (client-host)"
+    assert cp["gating_phase"] == "consume"
+    processes = {row["process"] for row in cp["per_rank"]}
+    assert "server pid 999 (server-host)" in processes
+
+    merged = json.load(open(merged_path))
+    # Flow ids survive un-namespaced (they must match across
+    # processes); span ids are namespaced per process.
+    flow_ids = {
+        e["id"]
+        for e in merged["traceEvents"]
+        if e.get("ph") in ("s", "t", "f")
+    }
+    assert flow_ids == {"restore-abc/100.1"}
+    span_ids = {
+        e["id"]
+        for e in merged["traceEvents"]
+        if e.get("ph") in ("b", "e")
+    }
+    assert all(":" in str(i) for i in span_ids)
+
+
+def test_merge_flow_pairs_align_barrierless_server_clock(tmp_path, capsys):
+    a = tmp_path / "client.json"
+    b = tmp_path / "server.json"
+    a.write_text(json.dumps(_client_doc()))
+    b.write_text(json.dumps(_server_doc(skew_s=0.5)))
+    assert (
+        merge.main(
+            [str(a), str(b), "-o", str(tmp_path / "m.json"), "--json"]
+        )
+        == 0
+    )
+    info = json.loads(capsys.readouterr().out)
+    # The server has no barrier anchors; its skew comes from the
+    # paired flow midpoint: t_wall(0.11 + 0.5 skew) vs client bracket
+    # midpoint (0.01 + 0.21)/2 = 0.11 → skew ≈ +0.5.
+    assert info["skew_s"]["server:999"] == pytest.approx(0.5, abs=0.01)
+    assert info["skew_s"]["0"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_summarize_merged_trace_names_gating_process(tmp_path, capsys):
+    a = tmp_path / "client.json"
+    b = tmp_path / "server.json"
+    a.write_text(json.dumps(_client_doc()))
+    b.write_text(json.dumps(_server_doc(skew_s=0.5)))
+    merged_path = str(tmp_path / "m.json")
+    assert merge.main([str(a), str(b), "-o", merged_path]) == 0
+    capsys.readouterr()
+    assert summarize.main([merged_path]) == 0
+    out = capsys.readouterr().out
+    assert "critical path: rank 0 gated the commit" in out
+    # The server's row joins the skew table through its skew_key (the
+    # table keys by "<role>:<os-pid>", the row by merged pid) — the
+    # flow-pair-corrected skew must actually render.
+    server_line = next(
+        ln for ln in out.splitlines()
+        if "server pid 999 (server-host)" in ln
+    )
+    assert "clock skew +0.5" in server_line, server_line
+
+
+# --------------------------------------------------- summarize breakdown
+
+
+def _fixture_trace_with_substeps(tmp_path):
+    """A restore trace with consume.* sub-step spans (what the
+    micro-profiler emits while tracing is on)."""
+    events = []
+    sid = [0]
+
+    def span(name, b_us, e_us, **args):
+        sid[0] += 1
+        events.append(
+            {"name": name, "cat": "snapshot", "ph": "b", "id": sid[0],
+             "ts": float(b_us), "pid": 1, "tid": 1,
+             **({"args": args} if args else {})}
+        )
+        events.append(
+            {"name": name, "cat": "snapshot", "ph": "e", "id": sid[0],
+             "ts": float(e_us), "pid": 1, "tid": 1}
+        )
+
+    span("read", 0, 100_000, bytes=1 << 28)
+    span("consume", 100_000, 1_100_000, bytes=1 << 28)
+    span("consume.decode", 100_000, 700_000, bytes=1 << 28)
+    span("consume.verify", 700_000, 900_000, bytes=1 << 28)
+    span("consume.device_put", 900_000, 1_050_000, bytes=1 << 28)
+    p = tmp_path / "fixture.json"
+    p.write_text(
+        json.dumps(
+            {
+                "traceEvents": events,
+                "metadata": {"clock_epoch_s": 0.0, "rank": 0,
+                             "host": "h", "pid": 1},
+            }
+        )
+    )
+    return str(p)
+
+
+def test_summarize_folds_consume_substeps_golden(tmp_path, capsys):
+    """Golden-ish: the summarize output for a fixture trace names the
+    dominant sub-step and the per-sub-step shares."""
+    path = _fixture_trace_with_substeps(tmp_path)
+    assert summarize.main([path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    breakdown = doc["consume_breakdown"]
+    assert breakdown["dominant_substep"] == "decode"
+    assert breakdown["substeps"]["decode"]["share"] == pytest.approx(
+        0.6, abs=0.01
+    )
+    assert breakdown["substeps"]["verify"]["share"] == pytest.approx(
+        0.2, abs=0.01
+    )
+    assert summarize.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "consume breakdown (dominant sub-step: decode):" in out
+    assert "consume.decode" in out
+    assert "60.0% of consume" in out
+    # The plain dominance verdict still fires (consume >= 3x read).
+    assert "restore is consume-dominated" in out
+
+
+# ------------------------------------------------ per-process trace files
+
+
+def test_env_trace_path_role_and_pid_suffixes():
+    pid = os.getpid()
+    assert tracing.derive_env_path("/tmp/t.json", None) == (
+        f"/tmp/t.pid{pid}.json"
+    )
+    assert tracing.derive_env_path("/tmp/t.json", "server") == (
+        f"/tmp/t.server.pid{pid}.json"
+    )
+    assert tracing.derive_env_path("/tmp/t-{pid}.json", None) == (
+        f"/tmp/t-{pid}.json"
+    )
+    assert tracing.derive_env_path("/tmp/t-{role}.json", "server") == (
+        f"/tmp/t-server.pid{pid}.json"
+    )
+
+
+def test_forked_child_flush_cannot_clobber_parent_trace(tmp_path):
+    """A child inheriting an enabled tracer (fork) re-suffixes its
+    output with its own pid instead of replacing the parent's file."""
+    parent_path = str(tmp_path / "trace.json")
+    tracing.enable(parent_path)
+    with tracing.span("parent-span"):
+        pass
+    assert tracing.flush() == parent_path
+    parent_doc = json.load(open(parent_path))
+
+    # Simulate the fork: the module state says "enabled at pid X" while
+    # os.getpid() returns something else.
+    tracing._pid_at_enable = os.getpid() + 1
+    try:
+        with tracing.span("child-span"):
+            pass
+        child_path = tracing.flush()
+    finally:
+        tracing._pid_at_enable = os.getpid()
+    assert child_path != parent_path
+    assert os.path.exists(child_path)
+    # Parent file untouched by the child's flush.
+    assert json.load(open(parent_path)) == parent_doc
+
+
+def test_server_subprocess_writes_distinct_trace_file(tmp_path):
+    """A snapserve server subprocess launched with the SAME
+    TPUSNAPSHOT_TRACE as its client writes its own role+pid-suffixed
+    file (satellite 1)."""
+    import subprocess
+    import sys
+
+    trace = str(tmp_path / "shared.json")
+    env = dict(
+        os.environ,
+        TPUSNAPSHOT_TRACE=trace,
+        TPUSNAPSHOT_TRACE_ROLE="server",
+        JAX_PLATFORMS="cpu",
+    )
+    code = (
+        "from torchsnapshot_tpu import tracing\n"
+        "print(tracing.flush())\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    written = out.stdout.strip().splitlines()[-1]
+    assert written != trace
+    assert ".server.pid" in written
+    doc = json.load(open(written))
+    assert doc["metadata"]["role"] == "server"
+
+
+# ------------------------------------------------------- overhead guard
+
+
+def test_profiler_accounting_is_cheap_when_tracing_off():
+    """The always-on accounting is a monotonic pair per sub-step; with
+    tracing off and no profile scope the substep helper must be a
+    plain passthrough (no span machinery)."""
+    assert not tracing.enabled()
+    import timeit
+
+    def _noop_substep():
+        with consume_profile.substep(None, "decode", 0):
+            pass
+
+    per_call = timeit.timeit(_noop_substep, number=10000) / 10000
+    # Generous bound (contextmanager overhead only): the real guard is
+    # bench's <2% restore-wall criterion; this pins the no-op path.
+    assert per_call < 50e-6, per_call

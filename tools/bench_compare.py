@@ -36,6 +36,12 @@ Uncertified numbers (``restore_uncertified``/``degraded``) are compared
 but flagged in the output — a gate wired to flaky numbers should see
 the flake, not silently trust it.
 
+Consume sub-phase shifts (snapxray ``restore_consume_profile``) are
+reported as NOTES, never regressions: a sub-step whose share of the
+consume wall moved by >=10 points, and a change of dominant sub-step.
+The gated consume number is ``restore_consume_vs_h2d`` via timeline's
+bench-mode sentinel.
+
 Exit codes: 0 = no regression; 1 = regression past the threshold;
 2 = usage/parse error.
 """
@@ -201,7 +207,63 @@ def compare(
             f"note: dominant restore phase changed: "
             f"{verdicts[0] or '—'} -> {verdicts[1] or '—'}"
         )
+    lines.extend(_consume_profile_notes(old, new))
     return lines, regressions
+
+
+# A consume sub-step must shift by at least this fraction of the
+# consume wall before it earns a note — seconds-level churn between
+# rounds on a shared-tenancy link is weather, not a phase shift.
+_SUBSTEP_SHIFT_FRACTION = 0.1
+
+
+def _consume_profile_notes(
+    old: Dict[str, Any], new: Dict[str, Any]
+) -> List[str]:
+    """Note lines (never regressions) on restore consume sub-phase
+    shifts between two rounds (snapxray ``restore_consume_profile``):
+    a sub-step whose share of the consume wall moved by more than
+    ``_SUBSTEP_SHIFT_FRACTION``, and a change of dominant sub-step.
+    Sub-phase mix is diagnosis, not a gate — the gated number is
+    ``restore_consume_vs_h2d`` via timeline's sentinel."""
+    profiles = []
+    for doc in (old, new):
+        p = doc.get("restore_consume_profile")
+        wall = (p or {}).get("consume_s") or 0.0
+        subs = (p or {}).get("substeps") or {}
+        if not wall or not subs:
+            return []
+        profiles.append(
+            {
+                name: float(entry.get("seconds") or 0.0) / wall
+                for name, entry in subs.items()
+                if name != "read_wait"
+            }
+        )
+    notes: List[str] = []
+    shifted = []
+    for name in sorted(set(profiles[0]) | set(profiles[1])):
+        a = profiles[0].get(name, 0.0)
+        b = profiles[1].get(name, 0.0)
+        if abs(b - a) >= _SUBSTEP_SHIFT_FRACTION:
+            shifted.append(
+                f"{name} {100 * a:.0f}%->{100 * b:.0f}%"
+            )
+    if shifted:
+        notes.append(
+            "note: consume sub-phase mix shifted: "
+            + ", ".join(shifted)
+            + " (share of consume wall)"
+        )
+    dominants = tuple(
+        max(p, key=lambda n: p[n]) if p else None for p in profiles
+    )
+    if dominants[0] != dominants[1] and all(dominants):
+        notes.append(
+            f"note: dominant consume sub-step changed: "
+            f"{dominants[0]} -> {dominants[1]}"
+        )
+    return notes
 
 
 def _self_test() -> int:
@@ -321,6 +383,26 @@ def _self_test() -> int:
     assert reg and "codec ratio" in reg[0], f"ratio rise must fail: {reg}"
     _, reg = compare(base, dedup, 0.2)
     assert not reg, f"dedup keys absent on one side are skipped: {reg}"
+    # Consume sub-phase notes (snapxray): a mix shift and a dominant-
+    # sub-step change are NOTES, never regressions.
+    def _prof(device_put_s, decode_s):
+        return {
+            "consume_s": device_put_s + decode_s,
+            "substeps": {
+                "device_put": {"seconds": device_put_s, "bytes": 1},
+                "decode": {"seconds": decode_s, "bytes": 1},
+            },
+        }
+
+    xa = dict(base, restore_consume_profile=_prof(8.0, 2.0))
+    xb = dict(base, restore_consume_profile=_prof(2.0, 8.0))
+    lines, reg = compare(xa, xb, 0.2)
+    assert not reg, f"sub-phase shift must never regress the gate: {reg}"
+    joined = "\n".join(lines)
+    assert "consume sub-phase mix shifted" in joined, joined
+    assert "device_put -> decode" in joined, joined
+    lines, _ = compare(xa, dict(xa), 0.2)
+    assert not any("sub-phase" in ln for ln in lines), lines
     print("bench_compare self-test OK")
     return 0
 

@@ -821,7 +821,10 @@ def _apply_chunkstore_body(
 
 
 def decode_and_verify_chunk(
-    rec: Dict[str, Any], dtype_name: str, stored: Any
+    rec: Dict[str, Any],
+    dtype_name: str,
+    stored: Any,
+    profile: Any = None,
 ) -> bytes:
     """Decode one stored content chunk and verify its integrity —
     shared by the restore pipeline, ``Snapshot.verify``, and
@@ -836,9 +839,12 @@ def decode_and_verify_chunk(
     codec-tagged chunk whose decode fails but whose stored length
     equals the logical length falls back to identity (see
     ChunkStager's unsuitable-payload degrade) — the fingerprint check
-    still gates the bytes."""
+    still gates the bytes. ``profile`` (a
+    ``telemetry.consume_profile.ConsumeProfile``, or None) splits the
+    chunk's decode vs verify cost for the restore micro-profiler."""
     from .fingerprint import fingerprint_host
     from .serialization import verify_checksum
+    from .telemetry import consume_profile as _cprof
 
     key = rec["k"]
     logical_n = int(rec["n"])
@@ -859,11 +865,13 @@ def decode_and_verify_chunk(
         )
     else:
         try:
-            verify_checksum(stored, rec.get("cs"))
+            with _cprof.substep(profile, "verify", len(stored)):
+                verify_checksum(stored, rec.get("cs"))
         except Exception as e:
             stale_note = str(e)
     try:
-        logical = codecs.decode(codec, stored, dtype_name)
+        with _cprof.substep(profile, "decode", len(stored)):
+            logical = codecs.decode(codec, stored, dtype_name)
     except Exception:
         if codec is not None and len(stored) == logical_n:
             logger.warning(
@@ -883,7 +891,8 @@ def decode_and_verify_chunk(
         )
     if not codecs.is_lossy(codec):
         expected_fp = key.rsplit("-", 2)[0]
-        actual_fp = fingerprint_host(logical)
+        with _cprof.substep(profile, "verify", len(logical)):
+            actual_fp = fingerprint_host(logical)
         if actual_fp != expected_fp:
             raise RuntimeError(
                 f"content chunk {key}: stored bytes decode to content "

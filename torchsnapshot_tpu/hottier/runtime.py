@@ -53,7 +53,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..coord import Coordinator, get_coordinator
 from ..io_types import IOReq, emit_storage_op, io_payload
 from ..storage_plugin import is_ref_location
@@ -125,6 +125,11 @@ class _RootState:
         self.drain_lost = 0  # objects whose every replica died pre-drain
         self.drained_objects = 0  # THIS root's objects tiered down
         self.write_through = 0  # THIS root's objects written through
+        # The originating take's snapxray trace id (captured on the
+        # take path at enqueue/commit): drain + tierdown spans adopt
+        # it, so async tier-down appears in THAT take's causal trace
+        # however long after the ack it runs.
+        self.trace: Optional[str] = None
         # Items that exhausted their drain attempts: still pending (their
         # hot replicas stay unevictable — the only copy), re-driven by
         # the next drain_now(). wait_drained() reports them truthfully.
@@ -274,18 +279,24 @@ class HotTierRuntime:
         key = self._key(root, path)
         tag = tier.payload_tag(payload)
         placed = 0
-        for i, host in enumerate(self._placement_ring()):
-            if i >= self.k and placed >= self.k:
-                break
-            emit_storage_op("hottier.replicate", f"host{host}:{path}")
-            try:
-                if tier.put_replica(
-                    key, host, payload, tag, root.rstrip("/"),
-                    capacity_bytes=self.capacity_bytes,
-                ):
-                    placed += 1
-            except tier.HostLostError:
-                self._note_peer_failure(host, "dead")
+        # Runs on the take path: the span inherits the take's ambient
+        # trace id, so peer replication shows up inside the take's
+        # causal trace (the drain later re-adopts the same id).
+        with tracing.span(
+            "hottier.replicate", path=path, bytes=len(payload)
+        ):
+            for i, host in enumerate(self._placement_ring()):
+                if i >= self.k and placed >= self.k:
+                    break
+                emit_storage_op("hottier.replicate", f"host{host}:{path}")
+                try:
+                    if tier.put_replica(
+                        key, host, payload, tag, root.rstrip("/"),
+                        capacity_bytes=self.capacity_bytes,
+                    ):
+                        placed += 1
+                except tier.HostLostError:
+                    self._note_peer_failure(host, "dead")
         if placed == 0:
             # No replica landed: any stale replicas of an earlier object
             # at this key must not survive a write they no longer match.
@@ -458,9 +469,13 @@ class HotTierRuntime:
             tag = tier.key_tag(self._key(root, path))
         if nbytes is None:
             nbytes = tier.key_size_bytes(self._key(root, path))
+        ambient_trace = tracing.current_trace_id()
         with self._cond:
             self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
+            if ambient_trace is not None:
+                # Newest take to touch this root owns its drain trace.
+                state.trace = ambient_trace
             was_pending = path in state.pending
             prev = state.tags.get(path) if was_pending else None
             was_stranded = path in state.stranded
@@ -522,9 +537,12 @@ class HotTierRuntime:
         (all write-through, or drained already) gets a watermark-only
         queue item."""
         root = root.rstrip("/")
+        ambient_trace = tracing.current_trace_id()
         with self._cond:
             self._forgotten.discard(root)
             state = self._roots.setdefault(root, _RootState())
+            if ambient_trace is not None:
+                state.trace = ambient_trace
             state.committed = True
             if state.commit_t is None:
                 # The take's ack point: the durability-lag clock the
@@ -898,6 +916,9 @@ class HotTierRuntime:
         with self._cond:
             if not self._item_current_locked(root, path, tag):
                 return  # canceled or superseded while queued
+            state_trace = (
+                self._roots[root].trace if root in self._roots else None
+            )
         data: Optional[bytes] = None
         data_tag: Optional[str] = tag
         for host in tier.replica_hosts_for(key) or []:
@@ -957,7 +978,13 @@ class HotTierRuntime:
             return
         emit_storage_op("hottier.drain", path)
         try:
-            asyncio.run(plugin.write(IOReq(path=path, data=data)))
+            # The drain executor runs on its own thread long after the
+            # take returned: adopt the ORIGINATING take's trace id so
+            # this tier-down write appears in that take's causal trace.
+            with tracing.adopt_trace(state_trace), tracing.span(
+                "hottier.drain", path=path, bytes=len(data)
+            ):
+                asyncio.run(plugin.write(IOReq(path=path, data=data)))
         except Exception as e:
             if attempts + 1 < _DRAIN_MAX_ATTEMPTS:
                 with self._cond:
@@ -1061,6 +1088,7 @@ class HotTierRuntime:
             drained_objects = state.drained_objects
             write_through = state.write_through
             commit_t = state.commit_t
+            state_trace = state.trace
         # Per-take durability lag: the take's ack (its metadata commit,
         # observed by on_commit) → this watermark. THE number that
         # bounds the RPO exposure window the hot tier opened.
@@ -1084,14 +1112,19 @@ class HotTierRuntime:
             "ts_epoch_s": round(time.time(), 3),
         }
         try:
-            asyncio.run(
-                plugin.write(
-                    IOReq(
-                        path=TIERDOWN_FNAME,
-                        data=json.dumps(doc, sort_keys=True).encode("utf-8"),
+            with tracing.adopt_trace(state_trace), tracing.span(
+                "hottier.tierdown", root=root
+            ):
+                asyncio.run(
+                    plugin.write(
+                        IOReq(
+                            path=TIERDOWN_FNAME,
+                            data=json.dumps(doc, sort_keys=True).encode(
+                                "utf-8"
+                            ),
+                        )
                     )
                 )
-            )
         except Exception as e:
             # A failed watermark write must leave a re-drive trigger: the
             # root is fully drained, so no object item will ever call

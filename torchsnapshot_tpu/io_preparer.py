@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from . import tracing
+from .telemetry import consume_profile as _cprof
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from .utils.env import env_int
 from .ops.transfer import (
@@ -378,18 +379,24 @@ class ObjectBufferConsumer(BufferConsumer):
         self._size_hint = size_hint
         self._checksum = checksum
         self._compression = compression
+        # Consume micro-profile scope, captured at plan-build time (the
+        # restoring thread) so executor-thread notes attribute to the
+        # right restore (telemetry/consume_profile.py).
+        self._profile = _cprof.current()
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _load() -> Any:
-            verify_checksum(buf, self._checksum)
-            raw = (
-                decompress_payload(buf, self._compression)
-                if self._compression is not None
-                else buf
-            )
-            return bytes_to_object(raw)
+            with _cprof.substep(self._profile, "verify", len(buf)):
+                verify_checksum(buf, self._checksum)
+            if self._compression is not None:
+                with _cprof.substep(self._profile, "decode", len(buf)):
+                    raw = decompress_payload(buf, self._compression)
+            else:
+                raw = buf
+            with _cprof.substep(self._profile, "deserialize", len(raw)):
+                return bytes_to_object(raw)
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -450,39 +457,45 @@ class _ChunkCopyConsumer(BufferConsumer):
         self._compression = compression
         self._on_done = on_done
         self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
+        self._profile = _cprof.current()
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _copy() -> None:
-            verify_checksum(buf, self._checksum)
+            with _cprof.substep(self._profile, "verify", len(buf)):
+                verify_checksum(buf, self._checksum)
             if self._compression is not None:
-                buf_raw = decompress_payload(buf, self._compression)
+                with _cprof.substep(self._profile, "decode", len(buf)):
+                    buf_raw = decompress_payload(buf, self._compression)
             else:
                 buf_raw = buf
-            view = np.frombuffer(buf_raw, dtype=self._dtype).reshape(
-                self._view_shape
-            )
-            for region, region_slices, view_slices in self._copies:
-                if (
-                    len(self._copies) == 1
-                    and view.shape == region.buffer.shape
-                    and all(
-                        sl.start == 0 and sl.stop == dim
-                        for sl, dim in zip(region_slices, region.buffer.shape)
-                    )
-                    and all(
-                        sl.start == 0 and sl.stop == dim
-                        for sl, dim in zip(view_slices, view.shape)
-                    )
-                ):
-                    # The chunk exactly covers this region: adopt the
-                    # zero-copy view instead of memcpy-ing into the
-                    # preallocated buffer (np.frombuffer views are
-                    # read-only, which device_put accepts).
-                    region.buffer = view
-                else:
-                    region.buffer[region_slices] = view[view_slices]
+            with _cprof.substep(self._profile, "reassemble", self._cost):
+                view = np.frombuffer(buf_raw, dtype=self._dtype).reshape(
+                    self._view_shape
+                )
+                for region, region_slices, view_slices in self._copies:
+                    if (
+                        len(self._copies) == 1
+                        and view.shape == region.buffer.shape
+                        and all(
+                            sl.start == 0 and sl.stop == dim
+                            for sl, dim in zip(
+                                region_slices, region.buffer.shape
+                            )
+                        )
+                        and all(
+                            sl.start == 0 and sl.stop == dim
+                            for sl, dim in zip(view_slices, view.shape)
+                        )
+                    ):
+                        # The chunk exactly covers this region: adopt the
+                        # zero-copy view instead of memcpy-ing into the
+                        # preallocated buffer (np.frombuffer views are
+                        # read-only, which device_put accepts).
+                        region.buffer = view
+                    else:
+                        region.buffer[region_slices] = view[view_slices]
 
         def _copy_and_signal() -> None:
             _copy()
@@ -516,6 +529,7 @@ class _SplitObjectReadState:
         self._buf: Optional[bytearray] = None  # allocated on first absorb
         self._remaining = 0
         self._lock = threading.Lock()
+        self._profile = _cprof.current()
         # Scheduler budget-release callback for the shared assembly
         # reservation (charged as the first sub-read's deferred cost,
         # re-credited only here — when the buffer is actually freed —
@@ -566,17 +580,20 @@ class _SplitObjectReadState:
         executor: Optional[Executor] = None,
     ) -> None:
         def _copy() -> None:
-            with self._lock:
-                if self._buf is None:
-                    self._buf = bytearray(self.nbytes)
-            if len(buf) != end - start:
-                raise RuntimeError(
-                    f"Ranged sub-read returned {len(buf)} bytes for "
-                    f"[{start}, {end}) — object shorter than the manifest "
-                    f"implies (truncated or torn)."
-                )
-            # Disjoint ranges: concurrent executor threads never overlap.
-            memoryview(self._buf)[start:end] = buf
+            with _cprof.substep(
+                self._profile, "reassemble", end - start
+            ):
+                with self._lock:
+                    if self._buf is None:
+                        self._buf = bytearray(self.nbytes)
+                if len(buf) != end - start:
+                    raise RuntimeError(
+                        f"Ranged sub-read returned {len(buf)} bytes for "
+                        f"[{start}, {end}) — object shorter than the manifest "
+                        f"implies (truncated or torn)."
+                    )
+                # Disjoint ranges: concurrent executor threads never overlap.
+                memoryview(self._buf)[start:end] = buf
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -590,8 +607,11 @@ class _SplitObjectReadState:
             try:
                 await self._inner.consume_buffer(memoryview(self._buf), executor)
             finally:
-                self._buf = None  # free eagerly
-                self._release_assembly_cost()
+                with _cprof.substep(
+                    self._profile, "staging_release", self.nbytes
+                ):
+                    self._buf = None  # free eagerly
+                    self._release_assembly_cost()
 
 
 class _StreamingSplitState(_SplitObjectReadState):
@@ -697,19 +717,21 @@ class _StreamingSplitState(_SplitObjectReadState):
             flat = np.frombuffer(buf, dtype=self._np_dtype)
             # Eager H2D first: the transfer rides the link while later
             # sub-reads are still arriving from storage.
-            dev = chunked_device_put(flat, self._device)
+            with _cprof.substep(self._profile, "device_put", len(buf)):
+                dev = chunked_device_put(flat, self._device)
             if self._crc is not None:
-                drained = 0
-                with self._lock:
-                    self._stash[start] = buf
-                    while self._next_off in self._stash:
-                        b = self._stash.pop(self._next_off)
-                        self._crc.update(b)
-                        self._next_off += len(b)
-                        drained += len(b)
-                    release = self._cost_release
-                    if release is not None and drained:
-                        self._released += drained
+                with _cprof.substep(self._profile, "verify", len(buf)):
+                    drained = 0
+                    with self._lock:
+                        self._stash[start] = buf
+                        while self._next_off in self._stash:
+                            b = self._stash.pop(self._next_off)
+                            self._crc.update(b)
+                            self._next_off += len(b)
+                            drained += len(b)
+                        release = self._cost_release
+                        if release is not None and drained:
+                            self._released += drained
                 # Re-credit drained parts outside the state lock (the
                 # budget cell takes its own lock).
                 if release is not None and drained:
@@ -866,6 +888,7 @@ class _ContentChunksReadState:
         self._remaining = len(records)
         self._lock = threading.Lock()
         self._cost_release: Optional[Callable[[int], None]] = None
+        self._profile = _cprof.current()
 
     def set_cost_releaser(self, release: Callable[[int], None]) -> None:
         self._cost_release = release
@@ -894,7 +917,9 @@ class _ContentChunksReadState:
     def _decode_and_verify(self, rec: Dict[str, Any], buf: BufferType) -> bytes:
         from .chunkstore import decode_and_verify_chunk
 
-        return decode_and_verify_chunk(rec, self._dtype_name, buf)
+        return decode_and_verify_chunk(
+            rec, self._dtype_name, buf, profile=self._profile
+        )
 
     async def absorb(
         self,
@@ -905,11 +930,17 @@ class _ContentChunksReadState:
     ) -> None:
         def _consume_part() -> None:
             logical = self._decode_and_verify(rec, buf)
-            with self._lock:
-                if self._buf is None:
-                    self._buf = bytearray(self.nbytes)
-            # Disjoint offsets: concurrent executor threads never overlap.
-            memoryview(self._buf)[offset : offset + len(logical)] = logical
+            with _cprof.substep(
+                self._profile, "reassemble", len(logical)
+            ):
+                with self._lock:
+                    if self._buf is None:
+                        self._buf = bytearray(self.nbytes)
+                # Disjoint offsets: concurrent executor threads never
+                # overlap.
+                memoryview(self._buf)[
+                    offset : offset + len(logical)
+                ] = logical
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -925,10 +956,13 @@ class _ContentChunksReadState:
                     memoryview(self._buf), executor
                 )
             finally:
-                self._buf = None  # free eagerly
-                release, self._cost_release = self._cost_release, None
-                if release is not None:
-                    release(self.nbytes)
+                with _cprof.substep(
+                    self._profile, "staging_release", self.nbytes
+                ):
+                    self._buf = None  # free eagerly
+                    release, self._cost_release = self._cost_release, None
+                    if release is not None:
+                        release(self.nbytes)
 
 
 class _ContentChunkConsumer(BufferConsumer):
@@ -1067,6 +1101,7 @@ class ArrayRestorePlan:
         self._outstanding = 0
         self._finalized = False
         self._lock = threading.Lock()
+        self._profile = _cprof.current()
 
     def _on_req_done(self) -> None:
         with self._lock:
@@ -1317,101 +1352,118 @@ class ArrayRestorePlan:
 
     def _finalize_impl(self) -> None:
         if self._template_is_jax:
-            # One batched device_put for all shards: the runtime issues the
-            # host→device transfers in parallel (a serial per-shard loop is
-            # memcpy/PCIe-latency bound). Large buffers route through the
-            # chunked H2D path instead — a single big transfer leaves
-            # ~40% of the measured link bandwidth on the table
-            # (ops/transfer.py chunked_device_put).
-            buffers = []
-            devices = []
-            prebuilt: Dict[int, Any] = {}
-            for region in self._regions:
-                for device in region.devices:
-                    if region.device_chunks is not None:
-                        # Streaming reads: the bytes are already on
-                        # device as 1-D chunks keyed by flat offset —
-                        # concatenate in offset order + reshape there
-                        # instead of a host device_put.
-                        ordered = [
-                            region.device_chunks[k]
-                            for k in sorted(region.device_chunks)
-                        ]
-                        flat = (
-                            jnp.concatenate(ordered)
-                            if len(ordered) > 1
-                            else ordered[0]
+            # Streamed regions (device_chunks set) already noted their
+            # H2D bytes per chunk at absorb time — counting them again
+            # here would double the profile's device_put bytes; their
+            # finalize cost is only an on-device concat. Only regions
+            # placed from host buffers transfer bytes now.
+            with _cprof.substep(
+                self._profile,
+                "device_put",
+                sum(
+                    r.nbytes * max(1, len(r.devices))
+                    for r in self._regions
+                    if r.device_chunks is None
+                ),
+            ):
+                self._finalize_jax()
+            return
+        out = self._regions[0].buffer
+        if not out.flags.writeable:
+            # Adopted zero-copy payload views are read-only; host
+            # restores hand back writable arrays (apps mutate restored
+            # numpy state in place).
+            out = out.copy()
+        if self._prng_impl is not None:
+            out = jax.random.wrap_key_data(out, impl=self._prng_impl)
+        self._callback(out)
+
+    def _finalize_jax(self) -> None:
+        # One batched device_put for all shards: the runtime issues the
+        # host→device transfers in parallel (a serial per-shard loop is
+        # memcpy/PCIe-latency bound). Large buffers route through the
+        # chunked H2D path instead — a single big transfer leaves
+        # ~40% of the measured link bandwidth on the table
+        # (ops/transfer.py chunked_device_put).
+        buffers = []
+        devices = []
+        prebuilt: Dict[int, Any] = {}
+        for region in self._regions:
+            for device in region.devices:
+                if region.device_chunks is not None:
+                    # Streaming reads: the bytes are already on
+                    # device as 1-D chunks keyed by flat offset —
+                    # concatenate in offset order + reshape there
+                    # instead of a host device_put.
+                    ordered = [
+                        region.device_chunks[k]
+                        for k in sorted(region.device_chunks)
+                    ]
+                    flat = (
+                        jnp.concatenate(ordered)
+                        if len(ordered) > 1
+                        else ordered[0]
+                    )
+                    assembled = jnp.reshape(flat, tuple(region.sizes))
+                    prebuilt[len(buffers)] = assembled
+                    # Free the per-chunk arrays eagerly and return
+                    # the TRANSIENT half of the device reservation
+                    # (the assembled array's half stays charged — it
+                    # remains resident). Wait for the concat to
+                    # actually execute first: releasing at dispatch
+                    # time would re-admit new streams while chunks
+                    # and result still coexist.
+                    region.device_chunks = None
+                    del flat, ordered
+                    if region.device_releases:
+                        try:
+                            assembled.block_until_ready()
+                        # Only times the budget release; a real
+                        # failure re-raises at device_put below.
+                        except Exception:  # snapcheck: disable=swallowed-exception -- timing wait
+                            pass
+                        releases, region.device_releases = (
+                            region.device_releases,
+                            [],
                         )
-                        assembled = jnp.reshape(flat, tuple(region.sizes))
-                        prebuilt[len(buffers)] = assembled
-                        # Free the per-chunk arrays eagerly and return
-                        # the TRANSIENT half of the device reservation
-                        # (the assembled array's half stays charged — it
-                        # remains resident). Wait for the concat to
-                        # actually execute first: releasing at dispatch
-                        # time would re-admit new streams while chunks
-                        # and result still coexist.
-                        region.device_chunks = None
-                        del flat, ordered
-                        if region.device_releases:
-                            try:
-                                assembled.block_until_ready()
-                            # Only times the budget release; a real
-                            # failure re-raises at device_put below.
-                            except Exception:  # snapcheck: disable=swallowed-exception -- timing wait
-                                pass
-                            releases, region.device_releases = (
-                                region.device_releases,
-                                [],
-                            )
-                            for cb, nbytes in releases:
-                                cb(nbytes)
-                    buffers.append(region.buffer)
-                    devices.append(device)
-            chunk_mask = [
-                False
-                if i in prebuilt
-                else should_chunk_h2d(buf, dev)
-                for i, (buf, dev) in enumerate(zip(buffers, devices))
-            ]
-            arrays: List[Any] = [None] * len(buffers)
-            for i, arr in prebuilt.items():
-                arrays[i] = arr
-            # Large buffers stream chunked; the small remainder still
-            # goes in ONE batched device_put (a per-buffer loop over
-            # many small shards is exactly the latency-bound path the
-            # batching exists to avoid).
-            small = [
-                i
-                for i, chunked in enumerate(chunk_mask)
-                if not chunked and i not in prebuilt
-            ]
-            if small:
-                put = jax.device_put(
-                    [buffers[i] for i in small],
-                    [devices[i] for i in small],
-                )
-                for i, arr in zip(small, put):
-                    arrays[i] = arr
-            for i, chunked in enumerate(chunk_mask):
-                if chunked:
-                    arrays[i] = chunked_device_put(buffers[i], devices[i])
-            out = jax.make_array_from_single_device_arrays(
-                tuple(self._shape), self._sharding, arrays
+                        for cb, nbytes in releases:
+                            cb(nbytes)
+                buffers.append(region.buffer)
+                devices.append(device)
+        chunk_mask = [
+            False
+            if i in prebuilt
+            else should_chunk_h2d(buf, dev)
+            for i, (buf, dev) in enumerate(zip(buffers, devices))
+        ]
+        arrays: List[Any] = [None] * len(buffers)
+        for i, arr in prebuilt.items():
+            arrays[i] = arr
+        # Large buffers stream chunked; the small remainder still
+        # goes in ONE batched device_put (a per-buffer loop over
+        # many small shards is exactly the latency-bound path the
+        # batching exists to avoid).
+        small = [
+            i
+            for i, chunked in enumerate(chunk_mask)
+            if not chunked and i not in prebuilt
+        ]
+        if small:
+            put = jax.device_put(
+                [buffers[i] for i in small],
+                [devices[i] for i in small],
             )
-            if self._prng_impl is not None:
-                out = jax.random.wrap_key_data(out, impl=self._prng_impl)
-            self._callback(out)
-        else:
-            out = self._regions[0].buffer
-            if not out.flags.writeable:
-                # Adopted zero-copy payload views are read-only; host
-                # restores hand back writable arrays (apps mutate restored
-                # numpy state in place).
-                out = out.copy()
-            if self._prng_impl is not None:
-                out = jax.random.wrap_key_data(out, impl=self._prng_impl)
-            self._callback(out)
+            for i, arr in zip(small, put):
+                arrays[i] = arr
+        for i, chunked in enumerate(chunk_mask):
+            if chunked:
+                arrays[i] = chunked_device_put(buffers[i], devices[i])
+        out = jax.make_array_from_single_device_arrays(
+            tuple(self._shape), self._sharding, arrays
+        )
+        if self._prng_impl is not None:
+            out = jax.random.wrap_key_data(out, impl=self._prng_impl)
+        self._callback(out)
 
 
 def _chunk_nbytes(sizes: List[int], itemsize: int) -> int:

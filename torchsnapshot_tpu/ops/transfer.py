@@ -158,6 +158,72 @@ def chunked_device_put(arr: np.ndarray, device: Any) -> Any:
     return jnp.concatenate(parts).reshape(arr.shape)
 
 
+# ------------------------------------------------------------- H2D probe
+#
+# One-shot hardware-bound measurement for the restore flight report
+# (snapxray): consume GB/s only means something as a FRACTION of what
+# the link could do, the same way bench pins take against the D2H
+# probe. Memoized per process — the report wants an order-of-magnitude
+# anchor, not a bracketing measurement (bench's restore section still
+# brackets with fresh probes).
+
+_H2D_PROBE_BYTES_ENV_VAR = "TPUSNAPSHOT_H2D_PROBE_BYTES"
+_DEFAULT_H2D_PROBE_BYTES = 32 * 1024 * 1024
+
+_h2d_probe_lock = threading.Lock()
+_h2d_probe_memo: List[Optional[float]] = []
+
+
+def probe_h2d_gbps(refresh: bool = False) -> Optional[float]:
+    """Measured host→device bandwidth (GB/s) via the same chunked-put
+    transfer the restore path uses, synced by a forced device reduction
+    (``device_put`` returns before bytes cross the link). Best of two
+    runs, each with a FRESH host buffer — re-putting the same array
+    measures a cached staging path, not a restore. Memoized; ``refresh``
+    re-measures. Returns None when disabled
+    (``TPUSNAPSHOT_H2D_PROBE_BYTES=0``) or the probe fails (no device)."""
+    from ..utils.env import env_int
+
+    with _h2d_probe_lock:
+        if _h2d_probe_memo and not refresh:
+            return _h2d_probe_memo[0]
+    nbytes = env_int(_H2D_PROBE_BYTES_ENV_VAR, _DEFAULT_H2D_PROBE_BYTES)
+    result: Optional[float] = None
+    if nbytes > 0:
+        try:
+            import time
+
+            import jax.numpy as jnp
+
+            device = jax.devices()[0]
+            force = jax.jit(jnp.sum)
+            rng = np.random.default_rng(11)
+            n = max(1, nbytes // 4)
+            best = 0.0
+            for _ in range(2):
+                host = rng.standard_normal(n, dtype=np.float32)
+                begin = time.monotonic()
+                arr = chunked_device_put(host, device)
+                float(force(arr))
+                elapsed = time.monotonic() - begin
+                if elapsed > 0:
+                    best = max(best, host.nbytes / 1024**3 / elapsed)
+                arr.delete()
+                del host
+            result = best if best > 0 else None
+        # Capability probe: a backend without a usable device (or one
+        # that rejects delete()) yields "no probe", never a failed
+        # restore report.
+        except Exception:  # snapcheck: disable=swallowed-exception -- capability probe
+            result = None
+    with _h2d_probe_lock:
+        if _h2d_probe_memo:
+            _h2d_probe_memo[0] = result
+        else:
+            _h2d_probe_memo.append(result)
+    return result
+
+
 def is_oom_error(exc: BaseException) -> bool:
     if isinstance(exc, MemoryError):
         return True

@@ -41,6 +41,7 @@ import psutil
 
 from . import telemetry, tracing
 from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
+from .telemetry import consume_profile as _cprof
 from .telemetry import metrics as _metric_names
 
 logger = logging.getLogger(__name__)
@@ -405,7 +406,12 @@ async def execute_read_reqs(
 
     pending = deque(sorted(read_reqs, key=lambda r: -_sort_bytes(r)))
     reading: Dict[asyncio.Task, Tuple[ReadReq, int]] = {}
-    consumable: deque = deque()  # (ReadReq, buf, host_refund)
+    consumable: deque = deque()  # (ReadReq, buf, host_refund, ready_t)
+    # Consume micro-profile (snapxray): read_wait — a completed read's
+    # payload queued behind budget/executor pressure before its consume
+    # dispatched — is only measurable here, between the two pipeline
+    # stages. The scope was opened by the restore root in this thread.
+    profile = _cprof.current()
     consuming: Dict[asyncio.Task, int] = {}
     budget = _BudgetCell(memory_budget_bytes)
     device_budget = _BudgetCell(
@@ -474,7 +480,9 @@ async def execute_read_reqs(
             # bytes, which must fit HBM anyway as the restored array.
             while consumable:
                 pick = None
-                for i, (rr, _buf, _refund) in enumerate(consumable):
+                for i, (rr, _buf, _refund, _ready_t) in enumerate(
+                    consumable
+                ):
                     dcost = rr.buffer_consumer.get_device_cost_bytes()
                     if not dcost or device_budget.value >= dcost:
                         pick = i
@@ -487,8 +495,14 @@ async def execute_read_reqs(
                         budget_blocked = True
                         break
                     pick = 0
-                rr, buf, host_refund = consumable[pick]
+                rr, buf, host_refund, ready_t = consumable[pick]
                 del consumable[pick]
+                if profile is not None:
+                    profile.note(
+                        "read_wait",
+                        time.monotonic() - ready_t,
+                        len(buf),
+                    )
                 consumer = rr.buffer_consumer
                 dcost = consumer.get_device_cost_bytes()
                 if dcost:
@@ -526,7 +540,7 @@ async def execute_read_reqs(
                     rr, cost = reading.pop(task)
                     buf = io_payload(task.result())
                     bytes_read += len(buf)
-                    consumable.append((rr, buf, cost))
+                    consumable.append((rr, buf, cost, time.monotonic()))
                 else:
                     cost = consuming.pop(task)
                     task.result()  # propagate consume errors

@@ -30,7 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..io_types import IOReq, StoragePlugin, emit_storage_op, io_payload
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float
@@ -305,6 +305,13 @@ class SnapServePlugin(StoragePlugin):
         cooldown = env_float(
             DOWN_COOLDOWN_ENV_VAR, _DEFAULT_DOWN_COOLDOWN_S
         )
+        # The degraded TRANSITION as a trace instant (stamped with the
+        # restore's trace id by tracing): a mid-restore server death is
+        # visible in the merged trace at the exact moment fallback
+        # direct reads began — same causal chain, different data path.
+        tracing.instant(
+            "snapserve.degraded", addr=self._addr_str, cooldown_s=cooldown
+        )
         with self._lock:
             self._down_until = time.monotonic() + cooldown
 
@@ -318,23 +325,32 @@ class SnapServePlugin(StoragePlugin):
         self, path: str, byte_range: Optional[tuple]
     ) -> bytes:
         timeout_s = env_float(TIMEOUT_ENV_VAR, _DEFAULT_TIMEOUT_S)
+        # Causal context on the wire (snapxray): the restore root's
+        # trace id + a flow id the server's spans bind to — the merged
+        # trace draws the client→server arrow from this pair. Generated
+        # even when THIS process records no events (a tracing-on server
+        # still attributes its work to this restore).
+        trace_id = tracing.current_trace_id()
+        flow_id = tracing.flow_start(
+            "snapserve.rpc", path=path, addr=self._addr_str
+        )
         try:
             conn = await self._checkout()
         except _TRANSPORT_ERRORS as e:
             raise _TransportFailure(f"dial {self._addr_str}: {e!r}") from e
         reader, writer = conn
+        header_doc: Dict[str, Any] = {
+            "v": PROTOCOL_VERSION,
+            "op": "read",
+            "id": self._next_id(),
+            "backend": self._backend_url,
+            "path": path,
+            "range": list(byte_range) if byte_range else None,
+        }
+        if trace_id is not None or flow_id is not None:
+            header_doc["trace"] = {"id": trace_id, "flow": flow_id}
         try:
-            await send_frame(
-                writer,
-                {
-                    "v": PROTOCOL_VERSION,
-                    "op": "read",
-                    "id": self._next_id(),
-                    "backend": self._backend_url,
-                    "path": path,
-                    "range": list(byte_range) if byte_range else None,
-                },
-            )
+            await send_frame(writer, header_doc)
             header, payload = await asyncio.wait_for(
                 recv_frame(reader), timeout_s
             )
@@ -351,6 +367,9 @@ class SnapServePlugin(StoragePlugin):
                 ) from e
             raise
         self._checkin(conn)
+        # The response hop closes the flow: a Perfetto arrow back from
+        # the server's handling step to this client's enclosing read.
+        tracing.flow_end("snapserve.rpc", flow_id, path=path)
         if not header.get("ok"):
             # The SERVER answered: this is the backend's verdict
             # (not-found / range / backend failure), not unreachability

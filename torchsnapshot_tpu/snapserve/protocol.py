@@ -8,8 +8,17 @@ One frame both ways::
     payload bytes
 
 Request headers: ``{"v": 1, "op": ..., "backend": ..., "path": ...,
-"range": [start, end] | null}``. Response headers: ``{"v": 1, "ok":
-true, ...meta}`` or ``{"v": 1, "ok": false, "error": {"kind", "message"}}``.
+"range": [start, end] | null, "trace": {"id", "flow"} | absent}``.
+Response headers: ``{"v": 1, "ok": true, ...meta}`` or ``{"v": 1,
+"ok": false, "error": {"kind", "message"}}``.
+
+``trace`` is the snapxray causal context: ``id`` is the client's
+take/restore trace id (the server's spans adopt it, joining the
+client's causal chain in the merged trace) and ``flow`` a per-RPC flow
+id (the server emits the matching Perfetto flow step, the client the
+start/end — the cross-process arrows). Optional and ignorable: servers
+and clients from before the field interoperate unchanged, and a
+malformed ``trace`` never fails a read.
 
 Error marshalling preserves the io_types failure taxonomy across the
 hop: a server-side not-found comes back as ``FileNotFoundError`` and a

@@ -46,7 +46,7 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..io_types import IOReq, StoragePlugin, io_payload
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float, env_int
@@ -462,7 +462,8 @@ class ReadService:
         """One metered backend read (whole object or ranged)."""
         plugin = self._backend(backend_url)
         io_req = IOReq(path=path, byte_range=byte_range)
-        await plugin.read(io_req)
+        with tracing.span("snapserve.backend_fetch", path=path):
+            await plugin.read(io_req)
         data = bytes(io_payload(io_req))
         self._bump("backend_reads")
         self._bump("backend_read_bytes", len(data))
@@ -538,7 +539,9 @@ class ReadService:
         cached = self.cache.get(key)
         self._record_cache_events()
         if cached is not None:
+            tracing.instant("snapserve.cache_hit", path=path)
             return cached, "cache", False
+        tracing.instant("snapserve.cache_miss", path=path)
 
         if byte_range is not None:
             size = await self._object_size(backend_url, path)
@@ -577,6 +580,9 @@ class ReadService:
             telemetry.counter(
                 _metric_names.SNAPSERVE_SINGLEFLIGHT_COLLAPSES
             ).inc()
+            # Waiter: this request piggybacked on another request's
+            # backend fetch (whose span carries the LEADER's trace).
+            tracing.instant("snapserve.singleflight_wait", path=path)
         return data, ("singleflight" if collapsed else "backend"), False
 
     def _record_cache_events(self) -> None:
@@ -807,15 +813,37 @@ class SnapServer:
         op = header.get("op")
         payload = b""
         response: Dict[str, Any] = {"v": PROTOCOL_VERSION, "id": req_id}
+        # snapxray causal context from the frame: the client's trace id
+        # is adopted for everything this request does (every span below
+        # stamps it), and the flow step is the server half of the
+        # client's Perfetto arrow. Malformed context never fails a read.
+        wire_trace = header.get("trace")
+        if not isinstance(wire_trace, dict):
+            wire_trace = {}
+        trace_id = wire_trace.get("id")
+        flow_id = wire_trace.get("flow")
         try:
             if op == "read":
                 byte_range = header.get("range")
-                payload, meta = await self.service.handle_read(
-                    str(header.get("backend", "")),
-                    str(header.get("path", "")),
-                    tuple(byte_range) if byte_range else None,
-                    client=client,
-                )
+                with tracing.adopt_trace(
+                    trace_id if isinstance(trace_id, str) else None
+                ):
+                    tracing.flow_step(
+                        "snapserve.rpc",
+                        flow_id if isinstance(flow_id, str) else None,
+                        path=str(header.get("path", "")),
+                    )
+                    with tracing.span(
+                        "snapserve.request",
+                        path=str(header.get("path", "")),
+                        client=client,
+                    ):
+                        payload, meta = await self.service.handle_read(
+                            str(header.get("backend", "")),
+                            str(header.get("path", "")),
+                            tuple(byte_range) if byte_range else None,
+                            client=client,
+                        )
                 response.update(ok=True, **meta)
             elif op == "stats":
                 telemetry.counter(
@@ -986,6 +1014,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
     host, _, port = args.addr.rpartition(":")
+
+    # Standalone server process: its trace (if TPUSNAPSHOT_TRACE is
+    # set) identifies as the read plane, so the multi-process merge
+    # labels it "server" instead of a phantom extra rank.
+    tracing.set_identity(role="server")
 
     service = ReadService(
         cache_bytes=args.cache_bytes,

@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import telemetry, tracing
 from .coord import Coordinator, barrier_compat, get_coordinator
+from .telemetry import consume_profile as _consume_profile
 from .telemetry import export as telemetry_export
 from .telemetry import goodput as goodput_acct
 from .telemetry import ledger as runledger
@@ -190,9 +191,12 @@ class Snapshot:
         try:
             # The whole sync take blocks the caller's training loop:
             # attribute it to checkpoint time (telemetry/goodput.py).
-            with goodput_acct.blocked("sync_take"), tracing.span(
-                "Snapshot.take", path=path
-            ):
+            # trace_scope stamps the take's causal trace id (snapxray):
+            # every span below — and any hot-tier drain of this take's
+            # bytes, however late — carries it.
+            with goodput_acct.blocked("sync_take"), tracing.trace_scope(
+                "take"
+            ), tracing.span("Snapshot.take", path=path):
                 merged = cls._take_impl(
                     path=path,
                     app_state=app_state,
@@ -267,8 +271,13 @@ class Snapshot:
         try:
             # Only the foreground (the consistent-cut capture before
             # this returns) stalls training; the drain is free unless
-            # the caller blocks in wait() (accounted there).
-            with goodput_acct.blocked("async_stall"):
+            # the caller blocks in wait() (accounted there). The trace
+            # scope covers the capture; the background drain closure
+            # captures the id and re-adopts it on its own thread, so
+            # async tier-down appears in this take's causal trace.
+            with goodput_acct.blocked("async_stall"), tracing.trace_scope(
+                "async_take"
+            ):
                 cls._take_impl(
                     path=path,
                     app_state=app_state,
@@ -673,6 +682,10 @@ class Snapshot:
             # loop on the statusfile cadence.
             watch.attach_storage(storage, nonce)
 
+            # Captured HERE (the foreground, inside the take's trace
+            # scope); the drain thread re-adopts it below.
+            take_trace_id = tracing.current_trace_id()
+
             def _drain() -> None:
                 async def _run() -> None:
                     background.phase = "storage writes"
@@ -730,7 +743,11 @@ class Snapshot:
                     flight.local_export(recorder)
 
                 try:
-                    asyncio.run(_run())
+                    # Re-adopt the take's trace id on the drain thread:
+                    # background writes/commit spans join the take's
+                    # causal chain in the merged trace.
+                    with tracing.adopt_trace(take_trace_id):
+                        asyncio.run(_run())
                 finally:
                     # Drop this rank's chunk-store intent + close the
                     # store plugin on success AND failure (a crashed
@@ -783,9 +800,9 @@ class Snapshot:
         rank = coordinator.get_rank()
         storage = self._open_storage()
         try:
-            with goodput_acct.blocked("restore"), tracing.span(
-                "Snapshot.restore", path=self.path
-            ):
+            with goodput_acct.blocked("restore"), tracing.trace_scope(
+                "restore"
+            ), tracing.span("Snapshot.restore", path=self.path):
                 return self._restore_impl(
                     app_state, coordinator, rank, storage, paths,
                     verify_device=verify_device,
@@ -833,6 +850,13 @@ class Snapshot:
         from .snapserve import client as _snapserve_client
 
         read_plane_token = _snapserve_client.restore_stats_begin()
+        # Consume micro-profiler (telemetry/consume_profile.py): every
+        # buffer consumer built below captures this scope and notes its
+        # sub-steps (decode/verify/reassemble/device_put/…) into it —
+        # the WHERE inside consume that the consume-dominated-restore
+        # doctor rule could not name before. Always on (the accounting
+        # is a monotonic pair per chunk sub-step).
+        consume_prof_token = _consume_profile.begin()
 
         app_state = dict(app_state)
         rng_key, rng_stateful = _pop_rng_state(app_state)
@@ -887,7 +911,12 @@ class Snapshot:
         if read_plane_summary is not None:
             recorder.note(read_plane=read_plane_summary)
         self._finish_restore_report(
-            recorder, read_stats, storage, rank, coordinator
+            recorder,
+            read_stats,
+            storage,
+            rank,
+            coordinator,
+            consume_prof_token=consume_prof_token,
         )
         if verify_device:
             verified, skipped = _verify_restored_fingerprints(verify_jobs)
@@ -915,6 +944,7 @@ class Snapshot:
         storage: StoragePlugin,
         rank: int,
         coordinator: Coordinator,
+        consume_prof_token: Any = None,
     ) -> None:
         """Fold the read pipeline's stats into the flight recorder,
         gather every rank's summary over the coordinator (the restore
@@ -928,13 +958,38 @@ class Snapshot:
         assemble_s = read_stats.pop("assemble_s", 0.0)
         recorder.note_pipeline(read_stats)
         ops = read_stats.get("ops") or {}
+        consume_agg = ops.get("consume") or {}
+        consume_s = consume_agg.get("seconds", 0.0)
         recorder.add_phase(
             "read", (ops.get("read") or {}).get("seconds", 0.0)
         )
-        recorder.add_phase(
-            "consume", (ops.get("consume") or {}).get("seconds", 0.0)
-        )
+        recorder.add_phase("consume", consume_s)
         recorder.add_phase("assemble", assemble_s)
+        # Consume sub-phase breakdown (snapxray): seconds + bytes per
+        # sub-step, reconciling with the consume wall by construction
+        # (the `other` bucket absorbs unaccounted consume time), plus
+        # consume GB/s as a fraction of the one-shot H2D probe — the
+        # hardware bound ROADMAP item 1's rewrite is judged against.
+        try:
+            profile_block = _consume_profile.collect(
+                consume_prof_token, consume_s=consume_s
+            )
+            if profile_block is not None:
+                consumed_bytes = int(consume_agg.get("bytes", 0))
+                profile_block["bytes"] = consumed_bytes
+                if consume_s > 0 and consumed_bytes > 0:
+                    gbps = consumed_bytes / (1 << 30) / consume_s
+                    profile_block["consume_gbps"] = round(gbps, 6)
+                    probe = _probe_h2d_for_report(consumed_bytes)
+                    if probe:
+                        profile_block["h2d_probe_gbps"] = round(probe, 4)
+                        profile_block["h2d_fraction"] = round(
+                            gbps / probe, 6
+                        )
+                recorder.note(consume_profile=profile_block)
+        except Exception as e:
+            # Observability may never fail the restore it describes.
+            logger.warning("consume-profile collection failed: %r", e)
         # Observability may never fail the restore it describes: the
         # state is fully restored by now, so even the gather collective
         # failing (KV hiccup/timeout) is caught — every rank catches
@@ -2053,6 +2108,28 @@ class _BaseFromRank0:
 
 
 BASE_FROM_RANK0 = _BaseFromRank0()
+
+
+# The one-shot H2D probe only runs for restores that moved at least
+# this much payload: a probe (~2 small chunked puts) is noise-free
+# context on a 100 GiB restore and pure overhead on a 4 KiB one. 0
+# probes every restore (tests, CI smoke).
+_H2D_PROBE_MIN_BYTES_ENV_VAR = "TPUSNAPSHOT_H2D_PROBE_MIN_BYTES"
+_DEFAULT_H2D_PROBE_MIN_BYTES = 64 << 20
+
+
+def _probe_h2d_for_report(consumed_bytes: int) -> Optional[float]:
+    """The flight report's H2D anchor (ops/transfer.py probe, memoized
+    per process): consume GB/s is only meaningful as a fraction of what
+    the link measures — the way bench pins take against the D2H probe."""
+    floor = env_int(
+        _H2D_PROBE_MIN_BYTES_ENV_VAR, _DEFAULT_H2D_PROBE_MIN_BYTES
+    )
+    if consumed_bytes < floor:
+        return None
+    from .ops.transfer import probe_h2d_gbps
+
+    return probe_h2d_gbps()
 
 
 def _resolve_base_arg(base: Optional[Any]) -> Optional[Any]:

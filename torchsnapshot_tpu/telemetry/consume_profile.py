@@ -1,0 +1,181 @@
+"""Consume micro-profiler: sub-step attribution inside the restore path.
+
+The flight recorder and the ``consume-dominated-restore`` doctor rule
+can say a restore spent 176s in ``consume`` against 0.76s of ``read``
+(BENCH_r05) — but not WHERE inside consume the time went, which is the
+number the streaming-restore rewrite (ROADMAP item 1) must be planned
+from and certified against. This module is that number: an always-on,
+contextvar-scoped accumulator the restore root opens and every buffer
+consumer notes into, at per-leaf/per-chunk granularity:
+
+==================  ====================================================
+sub-step            what it times
+==================  ====================================================
+read_wait           a completed read's payload sitting in the scheduler
+                    queue before its consume dispatched (budget / device-
+                    budget / executor pressure — NOT part of consume wall)
+deserialize         pickled-object loads (``bytes_to_object``) and raw
+                    byte→array reinterpretation
+decode              codec work: ``decompress_payload`` and chunk-store
+                    codec decode (zlib/zstd/int8)
+verify              integrity: checksum verification, streaming crc
+                    folds, content-fingerprint checks
+reassemble          host memcpy: scattering chunk views into region
+                    buffers, splicing ranged sub-reads into assembly
+                    buffers
+device_put          H2D transfers: streamed chunk puts and the
+                    finalize-time batched/chunked device placement
+staging_release     freeing assembly/staging buffers and re-crediting
+                    scheduler budget reservations
+other               consume wall the sub-steps above did not account
+                    for (event-loop/executor scheduling, GIL waits) —
+                    computed at collect time so the breakdown SUMS to
+                    the consume wall exactly
+==================  ====================================================
+
+Scoping matches the snapserve read-plane attribution: the profile is a
+contextvar set in the restoring thread; consumers CAPTURE it (and the
+ambient trace id) at plan-build time — which happens in that thread —
+so notes from executor threads land in the right restore even with two
+restores in flight. Cost when nothing special is happening: one
+``time.monotonic()`` pair per noted sub-step per chunk, well under the
+<2% restore-wall budget bench's restore section enforces; sub-step
+tracing spans are emitted only while tracing is enabled.
+"""
+
+import contextvars
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional, Tuple
+
+from .. import tracing
+
+# Sub-steps that run INSIDE consume_buffer (their seconds reconcile
+# against the scheduler's consume op seconds); read_wait happens between
+# read completion and consume dispatch and is reported beside them.
+IN_CONSUME_SUBSTEPS = (
+    "deserialize",
+    "decode",
+    "verify",
+    "reassemble",
+    "device_put",
+    "staging_release",
+)
+SUBSTEPS = ("read_wait",) + IN_CONSUME_SUBSTEPS
+
+
+class ConsumeProfile:
+    """Thread-safe sub-step accumulator for ONE restore."""
+
+    __slots__ = ("_lock", "_agg", "trace_id")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # substep -> [count, seconds, bytes]
+        self._agg: Dict[str, list] = {}
+        # Captured at begin() so executor-thread sub-step spans can
+        # stamp the restore's trace id without a contextvar handoff.
+        self.trace_id = tracing.current_trace_id()
+
+    def note(self, substep: str, seconds: float, nbytes: int = 0) -> None:
+        with self._lock:
+            entry = self._agg.get(substep)
+            if entry is None:
+                entry = self._agg[substep] = [0, 0.0, 0]
+            entry[0] += 1
+            entry[1] += seconds
+            entry[2] += nbytes
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                substep: {
+                    "count": entry[0],
+                    "seconds": round(entry[1], 6),
+                    "bytes": entry[2],
+                }
+                for substep, entry in sorted(self._agg.items())
+            }
+
+
+_SCOPE: "contextvars.ContextVar[Optional[ConsumeProfile]]" = (
+    contextvars.ContextVar("tpusnapshot_consume_profile", default=None)
+)
+
+
+def begin() -> Tuple[ConsumeProfile, Any]:
+    """Open a per-restore profiling scope in the restoring thread."""
+    profile = ConsumeProfile()
+    return profile, _SCOPE.set(profile)
+
+
+def current() -> Optional[ConsumeProfile]:
+    """The active profile — captured by consumers at plan-build time."""
+    return _SCOPE.get()
+
+
+def collect(
+    token: Any, consume_s: Optional[float] = None
+) -> Optional[Dict[str, Any]]:
+    """Close the scope and build the flight-report block. ``consume_s``
+    (the scheduler's consume op seconds for this restore) yields the
+    ``other`` bucket, so the in-consume sub-steps plus ``other`` sum to
+    the consume wall exactly. None when nothing was noted (a restore of
+    primitives only)."""
+    if token is None:
+        return None
+    profile, var_token = token
+    try:
+        _SCOPE.reset(var_token)
+    except ValueError:
+        pass  # reset from a different context: scope still collected
+    substeps = profile.summary()
+    if not substeps and not consume_s:
+        return None
+    block: Dict[str, Any] = {"substeps": substeps}
+    accounted = sum(
+        substeps.get(s, {}).get("seconds", 0.0) for s in IN_CONSUME_SUBSTEPS
+    )
+    block["accounted_s"] = round(accounted, 6)
+    if consume_s is not None:
+        block["consume_s"] = round(consume_s, 6)
+        other = max(0.0, consume_s - accounted)
+        block["substeps"]["other"] = {
+            "count": 0,
+            "seconds": round(other, 6),
+            "bytes": 0,
+        }
+    return block
+
+
+@contextmanager
+def substep(
+    profile: Optional[ConsumeProfile], name: str, nbytes: int = 0
+):
+    """Time one sub-step into ``profile``. A plain passthrough when no
+    restore scope is active (``profile`` None) — verify()/read_object
+    paths reuse the instrumented consumers, and emitting
+    ``consume.<name>`` spans for them would hand summarize a bogus
+    consume-breakdown section for an operation that never restored.
+    While tracing is enabled, a span is emitted alongside the note,
+    stamped with the restore's trace id even from executor threads."""
+    if profile is None:
+        yield
+        return
+    if tracing.enabled():
+        span_args: Dict[str, Any] = {"bytes": nbytes}
+        if profile.trace_id is not None:
+            span_args["trace"] = profile.trace_id
+        with tracing.span(f"consume.{name}", **span_args):
+            t0 = time.monotonic()
+            try:
+                yield
+            finally:
+                profile.note(name, time.monotonic() - t0, nbytes)
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        profile.note(name, time.monotonic() - t0, nbytes)

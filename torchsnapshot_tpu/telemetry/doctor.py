@@ -21,7 +21,12 @@ Rule catalog (docs/OBSERVABILITY.md carries the narrative version):
 ========================  =============================================
 id                        trigger
 ========================  =============================================
-consume-dominated-restore consume phase >= 3x the read phase
+consume-dominated-restore consume phase >= 3x the read phase; when the
+                          report carries the snapxray consume sub-phase
+                          breakdown, evidence names the dominant
+                          sub-step (decode/verify/reassemble/
+                          device_put/…) and the remediation is
+                          sub-step-specific
 read-dominated-restore    read phase >= 3x the consume phase
 stage-dominated-take      stage busy >= 3x write busy (scheduler ops)
 budget-stall-dominated    budget stall >= 25% of a rank's wall time
@@ -125,6 +130,69 @@ def _median(values: List[float]) -> float:
 # report so cross-rank rules (straggler, stripe) need no special casing.
 
 
+# Per-sub-step remediation for the consume-dominated verdict (snapxray
+# micro-profiler, telemetry/consume_profile.py): the generic "consume is
+# slow" advice becomes an actionable name once the breakdown says WHICH
+# sub-step dominates.
+_CONSUME_SUBSTEP_REMEDIATION = {
+    "decode": (
+        "codec decode dominates: zlib inflate is single-threaded per "
+        "buffer — switch to zstd (TPUSNAPSHOT_CODEC) or drop "
+        "compression for restore-latency-critical snapshots; chunk-"
+        "store decodes already overlap reads, so more chunks ≠ faster "
+        "decode."
+    ),
+    "deserialize": (
+        "object deserialization dominates: large pickled objects "
+        "(optimizer states saved as raw Python objects) restore "
+        "single-threaded — convert them to arrays so they take the "
+        "zero-copy array path."
+    ),
+    "verify": (
+        "integrity verification dominates: checksums/fingerprints are "
+        "CPU-bound per buffer. Keep verification on (it is the "
+        "corruption net) but check for double verification "
+        "(TPUSNAPSHOT_STRICT_INTEGRITY forces whole-object reads + "
+        "full checksums) and prefer the chunk store's on-device "
+        "fingerprints for large arrays."
+    ),
+    "reassemble": (
+        "host memcpy dominates: bytes are being copied into assembly "
+        "buffers before device placement. Larger contiguous chunks "
+        "(raise TPUSNAPSHOT_CHUNK_BYTES) and the streaming read path "
+        "(uncompressed, chunk-aligned payloads) skip host reassembly "
+        "entirely."
+    ),
+    "device_put": (
+        "H2D transfer dominates: the restore is at (or near) the "
+        "hardware bound — compare consume GB/s against h2d_probe_gbps "
+        "in this report. If the fraction is low, transfers are not "
+        "overlapping reads: raise the device restore budget "
+        "(TPUSNAPSHOT_DEVICE_BUDGET_BYTES) so more regions stream "
+        "concurrently."
+    ),
+    "staging_release": (
+        "buffer release/accounting dominates — pathological; likely "
+        "lock contention between consume executors. Report this with "
+        "the trace."
+    ),
+    "other": (
+        "unaccounted consume time dominates (event-loop/executor "
+        "scheduling, GIL waits): the pipeline is overhead-bound, not "
+        "work-bound. Fewer, larger objects (raise chunk sizes) cut "
+        "per-request overhead."
+    ),
+}
+
+
+def _consume_profiles(report: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [
+        s.get("consume_profile")
+        for s in _ranks(report)
+        if s.get("consume_profile")
+    ]
+
+
 def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
     if report.get("kind") != "restore":
         return None
@@ -134,27 +202,66 @@ def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
         read, 1e-9
     ):
         return None
+    evidence = {
+        "consume_s": round(consume, 3),
+        "read_s": round(read, 3),
+        "ratio": round(consume / max(read, 1e-9), 1),
+    }
+    title = (
+        f"restore spent {consume:.2f}s deserializing / placing "
+        f"against {read:.2f}s of storage reads"
+    )
+    remediation = (
+        "storage is innocent — the bottleneck is host-side "
+        "deserialization / host->device placement. Check "
+        "compression settings (zlib inflate is single-threaded "
+        "per buffer), raise the device restore budget "
+        "(TPUSNAPSHOT_DEVICE_BUDGET_BYTES), and confirm "
+        "consumes overlap reads in the trace (summarize's overlap "
+        "column)."
+    )
+    # Micro-profiler upgrade (snapxray): when rank summaries carry the
+    # consume sub-phase breakdown, the finding names the dominant
+    # sub-step and swaps in its specific remediation.
+    profiles = _consume_profiles(report)
+    if profiles:
+        substeps: Dict[str, float] = {}
+        for p in profiles:
+            for name, entry in (p.get("substeps") or {}).items():
+                if name == "read_wait":
+                    continue
+                substeps[name] = substeps.get(name, 0.0) + float(
+                    entry.get("seconds") or 0.0
+                )
+        if substeps:
+            dominant = max(substeps, key=lambda s: substeps[s])
+            evidence["dominant_substep"] = dominant
+            evidence["dominant_substep_s"] = round(substeps[dominant], 3)
+            evidence["substeps_s"] = {
+                k: round(v, 3) for k, v in sorted(substeps.items())
+            }
+            fractions = [
+                p.get("h2d_fraction")
+                for p in profiles
+                if p.get("h2d_fraction") is not None
+            ]
+            if fractions:
+                evidence["consume_h2d_fraction"] = round(
+                    min(fractions), 4
+                )
+            title += (
+                f"; dominant sub-step: {dominant} "
+                f"({substeps[dominant]:.2f}s)"
+            )
+            remediation = _CONSUME_SUBSTEP_REMEDIATION.get(
+                dominant, remediation
+            )
     return Finding(
         rule="consume-dominated-restore",
         severity="critical",
-        title=(
-            f"restore spent {consume:.2f}s deserializing / placing "
-            f"against {read:.2f}s of storage reads"
-        ),
-        evidence={
-            "consume_s": round(consume, 3),
-            "read_s": round(read, 3),
-            "ratio": round(consume / max(read, 1e-9), 1),
-        },
-        remediation=(
-            "storage is innocent — the bottleneck is host-side "
-            "deserialization / host->device placement. Check "
-            "compression settings (zlib inflate is single-threaded "
-            "per buffer), raise the device restore budget "
-            "(TPUSNAPSHOT_DEVICE_RESTORE_BUDGET_BYTES), and confirm "
-            "consumes overlap reads in the trace (summarize's overlap "
-            "column)."
-        ),
+        title=title,
+        evidence=evidence,
+        remediation=remediation,
     )
 
 

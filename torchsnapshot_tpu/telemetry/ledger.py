@@ -86,6 +86,15 @@ source operation did not produce them::
                                          # (restores routed through the
                                          # read service; fallbacks =
                                          # direct degraded reads)
+      "consume": {"substeps": {"<substep>": {"seconds", "bytes"}},
+                  "consume_s", "consume_gbps",
+                  "h2d_probe_gbps", "h2d_fraction"} | null,
+                                         # snapxray consume sub-phase
+                                         # breakdown (restores only):
+                                         # substeps + `other` sum to
+                                         # consume_s; h2d_fraction =
+                                         # consume GB/s over the
+                                         # measured H2D probe
       "durability_lag_s": null,          # ALWAYS null on take records —
                                          # the digest is written at commit,
                                          # while the ack→.tierdown window
@@ -581,6 +590,52 @@ def _read_plane_totals(
     return out
 
 
+def _consume_totals(
+    summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank consume micro-profiles (snapxray,
+    telemetry/consume_profile.py) into the digest's ``consume`` field:
+    seconds + bytes per sub-step summed across ranks, the consume wall
+    they reconcile against, and consume GB/s as a fraction of the
+    slowest rank's H2D probe. None when no rank profiled (takes, or
+    pre-snapxray restores)."""
+    noted = [
+        s.get("consume_profile")
+        for s in summaries
+        if s and s.get("consume_profile")
+    ]
+    if not noted:
+        return None
+    substeps: Dict[str, Dict[str, float]] = {}
+    for p in noted:
+        for name, entry in (p.get("substeps") or {}).items():
+            acc = substeps.setdefault(name, {"seconds": 0.0, "bytes": 0})
+            acc["seconds"] = round(
+                acc["seconds"] + float(entry.get("seconds") or 0.0), 6
+            )
+            acc["bytes"] = int(acc["bytes"]) + int(entry.get("bytes") or 0)
+    out: Dict[str, Any] = {
+        "substeps": {k: substeps[k] for k in sorted(substeps)},
+        "consume_s": round(
+            sum(float(p.get("consume_s") or 0.0) for p in noted), 6
+        ),
+    }
+    gbps = [p.get("consume_gbps") for p in noted if p.get("consume_gbps")]
+    if gbps:
+        out["consume_gbps"] = round(min(gbps), 6)
+    fractions = [
+        p.get("h2d_fraction") for p in noted if p.get("h2d_fraction")
+    ]
+    if fractions:
+        out["h2d_fraction"] = round(min(fractions), 6)
+    probes = [
+        p.get("h2d_probe_gbps") for p in noted if p.get("h2d_probe_gbps")
+    ]
+    if probes:
+        out["h2d_probe_gbps"] = round(min(probes), 4)
+    return out
+
+
 def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
     """Fold a merged flight report (take or restore) into one ledger
     record. Runs the doctor over the report so the record carries the
@@ -627,6 +682,7 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "churn": _churn_totals(summaries, nbytes),
         "tier": _tier_totals(summaries),
         "read_plane": _read_plane_totals(summaries),
+        "consume": _consume_totals(summaries),
         # Null by construction at commit time (see the schema note);
         # the hot tier's drain appends a `tierdown` event record that
         # carries the closed window.

@@ -15,6 +15,15 @@ that motivated this tool — BENCH_r05's restore spending 176.3s in
 ``consume`` against 0.76s of ``read`` — is flagged automatically
 instead of requiring a human to eyeball Perfetto.
 
+``consume.<substep>`` spans (the snapxray micro-profiler,
+``telemetry/consume_profile.py``) additionally fold into a **consume
+breakdown** naming the dominant sub-step and each sub-step's share of
+the consume phase's busy time — WHERE inside consume the time went.
+
+A merged multi-process trace (``telemetry/merge.py``) appends the
+cross-process critical path: which rank or read-plane server gated the
+operation.
+
 Exit codes: 0 = summarized; 1 = no spans in the trace; 2 = usage error.
 """
 
@@ -140,6 +149,33 @@ def summarize(spans: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             "instant": False,
         }
 
+    # Consume-breakdown fold (snapxray): consume.<substep> spans from
+    # the micro-profiler, as shares of the consume phase's busy time.
+    consume_busy = (phases.get("consume") or {}).get("busy_s", 0.0)
+    breakdown: Dict[str, Dict[str, Any]] = {}
+    for name, p in phases.items():
+        if not name.startswith("consume.") or p.get("instant"):
+            continue
+        sub = name[len("consume."):]
+        breakdown[sub] = {
+            "busy_s": p["busy_s"],
+            "total_s": p["total_s"],
+            "bytes": p["bytes"],
+            "share": (
+                round(min(1.0, p["busy_s"] / consume_busy), 4)
+                if consume_busy
+                else None
+            ),
+        }
+    consume_breakdown: Optional[Dict[str, Any]] = None
+    if breakdown:
+        dominant = max(breakdown, key=lambda s: breakdown[s]["busy_s"])
+        consume_breakdown = {
+            "substeps": breakdown,
+            "dominant_substep": dominant,
+            "consume_busy_s": consume_busy,
+        }
+
     verdict: Optional[Dict[str, Any]] = None
     for ops in (_READ_OPS, _WRITE_OPS):
         present = [op for op in ops if op in phases and not phases[op]["instant"]]
@@ -162,7 +198,10 @@ def summarize(spans: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         }
         if verdict is None or candidate["busy_s"] > verdict["busy_s"]:
             verdict = candidate
-    return {"phases": phases, "verdict": verdict}
+    out = {"phases": phases, "verdict": verdict}
+    if consume_breakdown is not None:
+        out["consume_breakdown"] = consume_breakdown
+    return out
 
 
 _ADVICE = {
@@ -224,6 +263,27 @@ def render(summary: Dict[str, Any]) -> str:
                 f"{verdict['dominant_phase']}-dominated"
                 + (f": {advice}" if advice else "")
             )
+    breakdown = summary.get("consume_breakdown")
+    if breakdown:
+        lines.append("")
+        lines.append(
+            f"consume breakdown (dominant sub-step: "
+            f"{breakdown['dominant_substep']}):"
+        )
+        for sub, p in sorted(
+            breakdown["substeps"].items(),
+            key=lambda kv: -kv[1]["busy_s"],
+        ):
+            share = p.get("share")
+            share_str = (
+                f"{100 * share:5.1f}% of consume"
+                if share is not None
+                else " " * 18
+            )
+            lines.append(
+                f"  consume.{sub:18s} {p['busy_s']:9.3f}s busy  "
+                f"{share_str}  {p['bytes'] / 1024**3:8.2f} GB"
+            )
     return "\n".join(lines)
 
 
@@ -249,15 +309,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("no spans found", file=sys.stderr)
         return 1
     if meta.get("merged"):
-        # A cross-rank merged trace (telemetry/merge.py): append the
-        # critical path — which rank/phase gated the commit — and the
-        # per-rank skew table the merge corrected with.
+        # A merged multi-process trace (telemetry/merge.py): append the
+        # critical path — which rank/server/phase gated the operation —
+        # and the per-process skew table the merge corrected with.
+        # Labels cover only ROLE processes (e.g. the snapserve server):
+        # rank processes keep the bare "rank N" rendering so reading a
+        # plain cross-rank merge is unchanged.
         from .merge import critical_path
 
+        labels = {
+            int(p["pid"]): p["label"]
+            for p in meta.get("processes") or []
+            if p.get("role")
+        }
         summary["cross_rank"] = {
             "ranks": meta.get("ranks"),
+            "processes": meta.get("processes"),
             "skew_s": meta.get("skew_s"),
-            "critical_path": critical_path(events),
+            "cross_process_flows": meta.get("cross_process_flows"),
+            "critical_path": critical_path(events, labels=labels),
         }
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
@@ -268,17 +338,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         if cp:
             print()
             print(
-                f"critical path: rank {cp['gating_rank']} gated the "
-                f"commit (last {cp['gating_phase']} ended at "
+                f"critical path: "
+                f"{cp.get('gating_process') or 'rank %s' % cp['gating_rank']} "
+                f"gated the commit (last {cp['gating_phase']} ended at "
                 f"{cp['gate_end_s']:.3f}s)"
             )
+            skews = cross.get("skew_s") or {}
+            # Role processes key the skew table by "<role>:<os-pid>",
+            # not the merged pid the critical-path rows carry — join
+            # through the processes table's skew_key.
+            skew_by_pid = {
+                int(p["pid"]): skews.get(p.get("skew_key"), 0.0)
+                for p in cross.get("processes") or []
+            }
             for row in cp["per_rank"]:
+                label = row.get("process") or f"rank {row['rank']}"
+                skew = skew_by_pid.get(
+                    int(row["rank"]), skews.get(str(row["rank"]), 0.0)
+                )
                 print(
-                    f"  rank {row['rank']}: last {row['last_phase']} "
+                    f"  {label}: last {row['last_phase']} "
                     f"ended {row['last_end_s']:.3f}s, slack "
                     f"{row['slack_s']:.3f}s  "
-                    f"(clock skew "
-                    f"{(cross.get('skew_s') or {}).get(str(row['rank']), 0.0):+.6f}s)"
+                    f"(clock skew {skew:+.6f}s)"
                 )
     return 0
 

@@ -64,6 +64,12 @@ _TAKE_METRICS: List[_MetricDef] = [
 _RESTORE_METRICS: List[_MetricDef] = [
     ("wall_s", "restore seconds", "high", 0.05, None),
     ("gbps", "restore GB/s", "low", 0.0, None),
+    # snapxray consume profile: consume GB/s as a fraction of the H2D
+    # probe — the number ROADMAP item 1's streaming-restore rewrite is
+    # certified against. Dropping means consume is falling further
+    # behind the hardware bound. Null (no probe / pre-snapxray records)
+    # is missing data, never a regression.
+    ("consume.h2d_fraction", "consume/H2D fraction", "low", 0.02, 0.3),
 ]
 # Drain event records (kind "tierdown", appended by the hot tier when a
 # committed root fully tiers down): the durability-lag trend — the RPO
@@ -116,6 +122,15 @@ _BENCH_METRICS: List[_MetricDef] = [
     ),
     ("dedup_codec.effective_gbps", "dedup effective GB/s", "low", 0.05, 0.3),
     ("dedup_codec.codec_ratio", "bench codec ratio", "high", 0.02, 0.2),
+    # snapxray: bench's restore-section consume/H2D fraction — same
+    # sentinel rationale as the ledger-mode consume.h2d_fraction.
+    (
+        "restore_consume_vs_h2d",
+        "bench consume/H2D fraction",
+        "low",
+        0.02,
+        0.3,
+    ),
 ]
 
 
@@ -249,7 +264,7 @@ def render_ledger(records: List[Dict[str, Any]]) -> List[str]:
     lines = [
         f"{'record':>9s} {'kind':>10s} {'wall_s':>8s} {'GB/s':>8s} "
         f"{'stall%':>7s} {'retry':>5s} {'churn':>6s} {'goodput':>7s} "
-        f"{'durlag':>7s}  doctor"
+        f"{'durlag':>7s} {'c/h2d':>6s}  doctor"
     ]
     for i, r in enumerate(records):
         doctor = ",".join(r.get("doctor") or []) or "-"
@@ -263,7 +278,8 @@ def render_ledger(records: List[Dict[str, Any]]) -> List[str]:
             f"{_fmt(r.get('retries'), '5.0f')} "
             f"{_fmt(_get(r, 'churn.efficiency'), '6.2f')} "
             f"{_fmt(goodput_col, '7.3f')} "
-            f"{_fmt(_get(r, 'durability_lag_s'), '7.2f')}  {doctor}"
+            f"{_fmt(_get(r, 'durability_lag_s'), '7.2f')} "
+            f"{_fmt(_get(r, 'consume.h2d_fraction'), '6.2f')}  {doctor}"
         )
     return lines
 

@@ -24,21 +24,39 @@ event-loop thread, and async events render each span on its own lane
 where same-track duration events would overlap and garble the timeline.
 
 Multi-process runs: each process writes its own file — the env path gets
-a ``.pid<N>`` suffix (or substitute ``{pid}`` in the path yourself);
-``enable(path)`` writes exactly ``path``.
+a ``.pid<N>`` suffix, plus a role tag when ``TPUSNAPSHOT_TRACE_ROLE``
+is set (or substitute ``{pid}``/``{role}`` in the path yourself);
+``enable(path)`` writes exactly ``path``. ``flush()`` is fork-safe: a
+child process inheriting an enabled tracer re-suffixes its output with
+its OWN pid, so it can never clobber the parent's trace file.
+
+Causal context (snapxray): :func:`trace_scope` stamps a contextvar
+trace id at each take/restore root; every span/instant recorded while
+the context is active carries ``args.trace``, and :func:`flow_start` /
+:func:`flow_step` / :func:`flow_end` emit Perfetto flow events
+(``ph: s/t/f``) whose shared id links spans ACROSS processes — a
+RemoteSnapshot restore's client spans, the snapserve server's cache and
+backend-fetch spans, and the hot tier's background drain all join one
+causal chain (``telemetry/merge.py`` draws the arrows and computes the
+cross-process critical path). Context generation is independent of
+whether THIS process records events: a tracing-off client still
+propagates ids so a tracing-on server can attribute its spans.
 """
 
 import atexit
+import contextvars
 import itertools
 import json
 import os
 import socket
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 _TRACE_ENV_VAR = "TPUSNAPSHOT_TRACE"
+_TRACE_ROLE_ENV_VAR = "TPUSNAPSHOT_TRACE_ROLE"
 
 _lock = threading.Lock()
 _events: Optional[List[Dict[str, Any]]] = None
@@ -49,28 +67,146 @@ _t0: float = 0.0
 # cross-rank merge (telemetry/merge.py) aligns per-rank traces on it.
 _wall0: float = 0.0
 _rank: Optional[int] = None
+_role: Optional[str] = None
+# Pid at enable time: flush() compares against os.getpid() so a forked
+# child re-suffixes instead of clobbering the parent's file.
+_pid_at_enable: int = 0
 _span_ids = itertools.count(1)
+_flow_seq = itertools.count(1)
+
+# The ambient causal context: the trace id stamped at the nearest
+# enclosing take/restore root (None outside any root). Propagates into
+# asyncio tasks automatically; executor threads and background drains
+# adopt it explicitly (adopt_trace / per-object capture).
+_TRACE_CTX: "contextvars.ContextVar[Optional[str]]" = (
+    contextvars.ContextVar("tpusnapshot_trace_ctx", default=None)
+)
 
 
-def set_identity(rank: Optional[int] = None) -> None:
-    """Record this process's rank for the trace metadata. Called by the
-    snapshot paths the moment a coordinator resolves (cheap, idempotent);
-    single-rank traces default to rank 0 so every trace is
+def set_identity(
+    rank: Optional[int] = None, role: Optional[str] = None
+) -> None:
+    """Record this process's rank (and optionally its role — e.g.
+    ``"server"`` for a snapserve process) for the trace metadata. Called
+    by the snapshot paths the moment a coordinator resolves (cheap,
+    idempotent); single-rank traces default to rank 0 so every trace is
     self-describing and mergeable."""
-    global _rank
-    if rank is not None:
+    global _rank, _role
+    if rank is not None or role is not None:
         with _lock:
-            _rank = rank
+            if rank is not None:
+                _rank = rank
+            if role is not None:
+                _role = role
+
+
+# --------------------------------------------------------- causal context
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient trace id (None outside any take/restore root)."""
+    return _TRACE_CTX.get()
+
+
+def new_trace_id(kind: str) -> str:
+    return f"{kind}-{uuid.uuid4().hex[:12]}"
+
+
+@contextmanager
+def trace_scope(kind: str):
+    """Stamp a fresh trace id for one take/restore root. Yields the id.
+    Nested roots (a restore issued inside another operation) get their
+    own id — the innermost root wins, which is what per-operation
+    attribution wants."""
+    token = _TRACE_CTX.set(new_trace_id(kind))
+    try:
+        yield _TRACE_CTX.get()
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+@contextmanager
+def adopt_trace(trace_id: Optional[str]):
+    """Run a region under an INHERITED trace id (a snapserve server
+    handling a request that carried context, a hot-tier drain persisting
+    a take's bytes). No-op for None."""
+    if trace_id is None:
+        yield
+        return
+    token = _TRACE_CTX.set(trace_id)
+    try:
+        yield
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+def _new_flow_id() -> str:
+    """Globally-unique flow id: trace-scoped when a trace is active so
+    the id is meaningful even in a process that records no events."""
+    base = _TRACE_CTX.get() or "anon"
+    return f"{base}/{os.getpid()}.{next(_flow_seq)}"
+
+
+def _flow_event(ph: str, name: str, flow_id: str, args: Dict[str, Any]) -> None:
+    ev: Dict[str, Any] = {
+        "name": name,
+        "cat": "flow",
+        "ph": ph,
+        "id": flow_id,
+        "ts": (time.monotonic() - _t0) * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFFFFFF,
+    }
+    if ph == "f":
+        ev["bp"] = "e"  # bind to enclosing slice (Perfetto convention)
+    trace = _TRACE_CTX.get()
+    if trace is not None:
+        args = dict(args, trace=trace)
+    if args:
+        ev["args"] = args
+    evs = _events
+    if evs is not None:
+        with _lock:
+            evs.append(ev)
+
+
+def flow_start(name: str, **args: Any) -> Optional[str]:
+    """Open a cross-process flow (e.g. before sending an RPC). Returns
+    the flow id to put on the wire — generated whenever a trace context
+    is active OR this process records events (a tracing-off client still
+    hands a tracing-on server something to bind to); None otherwise."""
+    if _TRACE_CTX.get() is None and _events is None:
+        return None
+    flow_id = _new_flow_id()
+    if _events is not None:
+        _flow_event("s", name, flow_id, args)
+    return flow_id
+
+
+def flow_step(name: str, flow_id: Optional[str], **args: Any) -> None:
+    """Record the remote half of a flow (the server handling a request
+    whose frame carried ``flow_id``)."""
+    if flow_id is None or _events is None:
+        return
+    _flow_event("t", name, flow_id, args)
+
+
+def flow_end(name: str, flow_id: Optional[str], **args: Any) -> None:
+    """Close a flow (the client observing the response)."""
+    if flow_id is None or _events is None:
+        return
+    _flow_event("f", name, flow_id, args)
 
 
 def enable(path: str) -> None:
     """Start recording spans; ``flush()`` (or process exit) writes them."""
-    global _events, _path, _t0, _wall0
+    global _events, _path, _t0, _wall0, _pid_at_enable
     with _lock:
         _events = []
         _path = path
         _t0 = time.monotonic()
         _wall0 = time.time()
+        _pid_at_enable = os.getpid()
 
 
 def disable() -> None:
@@ -99,6 +235,14 @@ def flush() -> Optional[str]:
     with _lock:
         if _events is None or _path is None:
             return None
+        path = _path
+        if _pid_at_enable and os.getpid() != _pid_at_enable:
+            # Forked child: the inherited path belongs to the PARENT.
+            # Re-suffix with our own pid so the child's flush (atexit,
+            # disable) can never clobber the parent's trace file —
+            # the multi-process-merge prerequisite of distinct inputs.
+            root, ext = os.path.splitext(path)
+            path = f"{root}.pid{os.getpid()}{ext or '.json'}"
         payload = {
             "traceEvents": list(_events),
             "displayTimeUnit": "ms",
@@ -112,10 +256,10 @@ def flush() -> Optional[str]:
                 "rank": _rank if _rank is not None else 0,
                 "host": socket.gethostname(),
                 "pid": os.getpid(),
+                "role": _role,
                 "tracer": "torchsnapshot_tpu",
             },
         }
-        path = _path
     tmp = f"{path}.tmp{os.getpid()}"
     try:
         with open(tmp, "w") as f:
@@ -147,6 +291,11 @@ def span(name: str, **args: Any):
     tid = threading.get_ident() & 0xFFFFFFFF
     pid = os.getpid()
     span_id = next(_span_ids)
+    trace = _TRACE_CTX.get()
+    if trace is not None and "trace" not in args:
+        # Causal attribution: every span under a take/restore root (or
+        # an adopted remote/drain context) names its trace.
+        args = dict(args, trace=trace)
     begin = {
         "name": name,
         "cat": "snapshot",
@@ -184,6 +333,9 @@ def instant(name: str, **args: Any) -> None:
     """Record a zero-duration marker (e.g. "manifest committed")."""
     if _events is None:
         return
+    trace = _TRACE_CTX.get()
+    if trace is not None and "trace" not in args:
+        args = dict(args, trace=trace)
     ev = {
         "name": name,
         "ph": "i",
@@ -200,19 +352,32 @@ def instant(name: str, **args: Any) -> None:
             evs.append(ev)
 
 
+def derive_env_path(path: str, role: Optional[str]) -> str:
+    """The per-process output path for an env-configured trace: role
+    (when set) and pid suffixes keep every process's file distinct — a
+    snapserve server subprocess launched with the SAME
+    ``TPUSNAPSHOT_TRACE`` as its client must not clobber the client's
+    trace, and the multi-process merge needs both files. Literal
+    replace, not str.format — an env path with other braces must not
+    crash import."""
+    if "{role}" in path:
+        path = path.replace("{role}", role or "rank")
+        role = None  # placeholder consumed; no extra suffix
+    if "{pid}" in path:
+        return path.replace("{pid}", str(os.getpid()))
+    root, ext = os.path.splitext(path)
+    tag = f".{role}" if role else ""
+    return f"{root}{tag}.pid{os.getpid()}{ext or '.json'}"
+
+
 def _maybe_enable_from_env() -> None:
     path = os.environ.get(_TRACE_ENV_VAR)
     if not path:
         return
-    # One file per process: concurrent ranks/workers sharing the env var
-    # must not clobber each other's trace on flush. Literal replace, not
-    # str.format — an env path with other braces must not crash import.
-    if "{pid}" in path:
-        path = path.replace("{pid}", str(os.getpid()))
-    else:
-        root, ext = os.path.splitext(path)
-        path = f"{root}.pid{os.getpid()}{ext or '.json'}"
-    enable(path)
+    role = os.environ.get(_TRACE_ROLE_ENV_VAR) or None
+    if role is not None:
+        set_identity(role=role)
+    enable(derive_env_path(path, role))
     atexit.register(flush)
 
 
