@@ -186,6 +186,61 @@ def _gate(next_work: str, need_s: float) -> None:
         )
 
 
+# Per-section deadline accounting (fastlane satellite): BENCH_r05 lost
+# step_stall AND incremental to "skipped: hard deadline" because the
+# 176 s consume-dominated restore ate a budget only guarded by one
+# blunt constant. Every post-restore section now has its own floor;
+# the restore reserves the SUM of all floors up front, and each
+# section's gate requires its own floor PLUS the floors of every
+# section still behind it — an early overrun can no longer eat a later
+# section's floor, and a fixed (fast) restore un-skips everything. The
+# verdicts land in the summary's ``section_budget`` block so a reader
+# can see where the wall-clock went.
+_POST_RESTORE_SECTION_FLOORS = [
+    ("incremental", 90.0),
+    ("dedup_codec", 75.0),
+    ("hot_tier", 75.0),
+    ("every_step", 90.0),
+    ("read_fanout", 75.0),
+    ("step_stall", 90.0),
+]
+
+
+def _late_sections_reserve_s(after: str = None) -> float:
+    """Sum of the post-restore section floors still owed — all of them
+    (the restore's up-front reservation), or those strictly BEHIND
+    ``after`` (that section's pass-through reserve)."""
+    names = [n for n, _ in _POST_RESTORE_SECTION_FLOORS]
+    start = names.index(after) + 1 if after is not None else 0
+    return sum(f for _, f in _POST_RESTORE_SECTION_FLOORS[start:])
+
+
+def _section_gate(name: str) -> bool:
+    """Whether ``name`` may start: the remaining hard budget must cover
+    its own floor plus every later section's floor. Records the verdict
+    (and the numbers behind it) into ``section_budget``."""
+    own = dict(_POST_RESTORE_SECTION_FLOORS)[name]
+    behind = _late_sections_reserve_s(after=name)
+    rem = _remaining_s()
+    ok = rem >= own + behind
+    acct = _RESULTS.setdefault("section_budget", {})
+    acct[name] = {
+        "floor_s": own,
+        "reserve_behind_s": behind,
+        "remaining_at_gate_s": round(rem, 1),
+        "ran": ok,
+    }
+    return ok
+
+
+def _section_done(name: str) -> None:
+    acct = (_RESULTS.get("section_budget") or {}).get(name)
+    if acct:
+        acct["spent_s"] = round(
+            acct["remaining_at_gate_s"] - _remaining_s(), 1
+        )
+
+
 def _note_gap(section: str, reason: str) -> None:
     """Record a section the run never measured (deadline/budget): the
     summary's explicit ``gaps`` list, so timeline/bench_compare treat
@@ -234,6 +289,13 @@ def _summary_doc() -> dict:
         "restore_read_span_s": r.get("restore_read_span_s", 0),
         "restore_consume_span_s": r.get("restore_consume_span_s", 0),
         "restore_assemble_span_s": r.get("restore_assemble_span_s", 0),
+        "h2d_probe_gbps": r.get("h2d_probe_gbps"),
+        "restore_consume_profile": r.get("restore_consume_profile"),
+        "restore_consume_vs_h2d": r.get("restore_consume_vs_h2d"),
+        # Streaming-pipeline sentinel: overlap-engine H2D GB/s over the
+        # bracketed ceiling (~1.0 = wire-bound restore).
+        "restore_vs_h2d_ceiling": r.get("restore_vs_h2d_ceiling"),
+        "section_budget": r.get("section_budget"),
         # telemetry.summarize's dominant-phase call + the doctor's rule
         # hits for the timed restore: the BENCH JSON carries its own
         # diagnosis (BENCH_r05 would have read "consume-dominated"
@@ -1765,14 +1827,16 @@ def _bench_body(bench_dir: str) -> None:
         # (BENCH_r04/r05: the restore-certification payload ate the
         # budget and incremental/step_stall ended "skipped: hard
         # deadline" — a degraded round with the dedup headline
-        # missing). The restore sizes itself against what remains
-        # AFTER the reservation, shrinking its own payload rather than
-        # starving the sections behind it.
-        _LATE_SECTIONS_RESERVE_S = 330.0
+        # missing). The reservation is the SUM of the per-section
+        # floors (_POST_RESTORE_SECTION_FLOORS), and each section's
+        # gate re-checks its floor plus everything behind it — the
+        # restore sizes itself against what remains AFTER the
+        # reservation, shrinking its own payload rather than starving
+        # the sections behind it.
         remaining_for_restore_s = (
             total_budget_s
             - (time.monotonic() - bench_start)
-            - _LATE_SECTIONS_RESERVE_S
+            - _late_sections_reserve_s()
         )
         full_restore_est_s = (
             total_bytes / 1024**3 / max(min(probes), 1e-6) + 30.0
@@ -1953,6 +2017,17 @@ def _bench_body(bench_dir: str) -> None:
                     _RESULTS["restore_consume_vs_h2d"] = round(
                         c_gbps / max(ceil, 1e-9), 4
                     )
+                # The streaming pipeline's own sentinel number: the
+                # overlap engine's delivered H2D GB/s over the
+                # bracketed ceiling. ~1.0 = the wire, not the
+                # consumer, is the bottleneck; a slide back toward a
+                # consume-serialized restore drops it (gated in
+                # bench_compare + timeline as restore_vs_h2d_ceiling).
+                o_gbps = consume_profile.get("h2d_overlap_gbps")
+                if o_gbps:
+                    _RESULTS["restore_vs_h2d_ceiling"] = round(
+                        o_gbps / max(ceil, 1e-9), 4
+                    )
 
         attempts = [_timed_restore()]
         _record_restore(attempts)
@@ -1999,15 +2074,24 @@ def _bench_body(bench_dir: str) -> None:
         _phase("incremental take")
         inc_link_gbps = max(min(d2h_gbps, h2d_gbps), 1e-6)
         inc_est_s = 0.1 / inc_link_gbps
-        # Reserve headroom for the stall section + the summary emit; the
-        # section DEGRADES its payload inside what remains rather than
-        # skipping outright (BENCH_r05), and only a budget that cannot
-        # carry even the 10 MiB floor records a gap.
-        # Reserve headroom for dedup_codec + hot-tier + stall sections
-        # behind this one (the old 120 s reserve predates dedup_codec).
-        inc_budget_s = _remaining_s() - 180.0
-        if _remaining_s() >= max(210.0, 2.2 * inc_est_s + 150.0):
+        # Reserve headroom for every section behind this one
+        # (per-section deadline accounting); the section DEGRADES its
+        # payload inside what remains rather than skipping outright
+        # (BENCH_r05), and only a budget that cannot carry even the
+        # 10 MiB floor records a gap.
+        inc_budget_s = _remaining_s() - _late_sections_reserve_s(
+            after="incremental"
+        )
+        if _remaining_s() >= max(
+            90.0 + _late_sections_reserve_s(after="incremental"),
+            2.2 * inc_est_s + 150.0,
+        ):
             inc_budget_s = None  # full budget: no reduction needed
+        # Accounting gate (records floors/remaining into
+        # section_budget); the RUN decision stays the section's own
+        # degrading logic — incremental shrinks its payload inside the
+        # pass-through reserve rather than skipping at its full floor.
+        _section_gate("incremental")
         if inc_budget_s is not None and (
             inc_budget_s < 30.0
             or inc_link_gbps * 1024**3 * inc_budget_s * 0.25 < 10 << 20
@@ -2017,11 +2101,13 @@ def _bench_body(bench_dir: str) -> None:
                 "skipped": "deadline",
                 "error": "skipped: hard deadline",
             }
+            _RESULTS["section_budget"]["incremental"]["ran"] = False
             _note_gap(
                 "incremental",
                 "remaining budget below the 10 MiB reduced floor",
             )
         else:
+            _RESULTS["section_budget"]["incremental"]["ran"] = True
             try:
                 _RESULTS["incremental"] = _run_incremental_block(
                     bench_dir,
@@ -2030,6 +2116,7 @@ def _bench_body(bench_dir: str) -> None:
                 )
             except Exception as e:
                 _RESULTS["incremental"] = {"ok": False, "error": repr(e)}
+            _section_done("incremental")
         print(
             f"[bench] incremental: {_RESULTS['incremental']}",
             file=sys.stderr,
@@ -2042,14 +2129,16 @@ def _bench_body(bench_dir: str) -> None:
         # incremental section; degrades to a reduced payload on a tight
         # budget instead of skipping.
         _phase("dedup + codec (chunkstore)")
-        if _remaining_s() < 75:
+        if not _section_gate("dedup_codec"):
             _RESULTS["dedup_codec"] = {
                 "ok": False,
                 "skipped": "deadline",
                 "error": "skipped: hard deadline",
             }
             _note_gap(
-                "dedup_codec", "remaining budget below the section floor"
+                "dedup_codec",
+                "remaining budget below the section floor plus the "
+                "floors behind it",
             )
         else:
             try:
@@ -2060,6 +2149,7 @@ def _bench_body(bench_dir: str) -> None:
                 )
             except Exception as e:
                 _RESULTS["dedup_codec"] = {"ok": False, "error": repr(e)}
+            _section_done("dedup_codec")
         print(
             f"[bench] dedup_codec: {_RESULTS['dedup_codec']}",
             file=sys.stderr,
@@ -2072,7 +2162,7 @@ def _bench_body(bench_dir: str) -> None:
         # certifies checkpoint overhead stays under
         # TPUSNAPSHOT_CKPT_BUDGET_PCT at every-step take frequency.
         _phase("hot tier")
-        if _remaining_s() < 75:
+        if not _section_gate("hot_tier"):
             _RESULTS["hot_tier"] = {
                 "ok": False,
                 "skipped": "deadline",
@@ -2084,10 +2174,11 @@ def _bench_body(bench_dir: str) -> None:
                 _RESULTS["hot_tier"] = run_hot_tier_block()
             except Exception as e:
                 _RESULTS["hot_tier"] = {"ok": False, "error": repr(e)}
+            _section_done("hot_tier")
         print(f"[bench] hot tier: {_RESULTS['hot_tier']}", file=sys.stderr)
 
         _phase("every-step checkpointing")
-        if _remaining_s() < 90:
+        if not _section_gate("every_step"):
             _RESULTS["every_step"] = {
                 "ok": False,
                 "skipped": "deadline",
@@ -2101,6 +2192,7 @@ def _bench_body(bench_dir: str) -> None:
                 _RESULTS["every_step"] = run_every_step_block()
             except Exception as e:
                 _RESULTS["every_step"] = {"ok": False, "error": repr(e)}
+            _section_done("every_step")
         print(
             f"[bench] every_step: {_RESULTS['every_step']}", file=sys.stderr
         )
@@ -2112,7 +2204,7 @@ def _bench_body(bench_dir: str) -> None:
         # <= 1.2x at N=32 (direct pays ~32x). Host-only numpy payloads
         # — tenancy-independent, fixed small budget like hot_tier.
         _phase("read fan-out (snapserve)")
-        if _remaining_s() < 75:
+        if not _section_gate("read_fanout"):
             _RESULTS["read_fanout"] = {
                 "ok": False,
                 "skipped": "deadline",
@@ -2126,6 +2218,7 @@ def _bench_body(bench_dir: str) -> None:
                 _RESULTS["read_fanout"] = run_read_fanout_block()
             except Exception as e:
                 _RESULTS["read_fanout"] = {"ok": False, "error": repr(e)}
+            _section_done("read_fanout")
         print(
             f"[bench] read_fanout: {_RESULTS['read_fanout']}",
             file=sys.stderr,
@@ -2138,7 +2231,7 @@ def _bench_body(bench_dir: str) -> None:
         # is measured against an idle device. Runs after the restore so
         # nothing else contends for the chip.
         _phase("in-situ stall")
-        if _remaining_s() < 90:
+        if not _section_gate("step_stall"):
             _RESULTS["step_stall"] = {
                 "ok": False,
                 "skipped": "deadline",
@@ -2156,6 +2249,7 @@ def _bench_body(bench_dir: str) -> None:
                 timeout_s=min(420.0, _remaining_s() - 60.0),
                 reduced=_remaining_s() < 240,
             )
+            _section_done("step_stall")
         print(f"[bench] step stall: {_RESULTS['step_stall']}", file=sys.stderr)
 
         # Certification verdict: a result is degraded if either headline

@@ -143,7 +143,13 @@ def test_restore_uses_chunked_h2d(monkeypatch, tmp_path):
         calls.append(arr.nbytes)
         return real(arr, dev)
 
+    # The chunked put may run at plan finalize (iop's symbol) or on the
+    # H2D overlap engine (the transfer module's symbol) when the region
+    # early-dispatches — spy on both.
+    import torchsnapshot_tpu.ops.transfer as transfer_mod
+
     monkeypatch.setattr(iop, "chunked_device_put", spy)
+    monkeypatch.setattr(transfer_mod, "chunked_device_put", spy)
     target = {"m": PytreeStateful({"w": jnp.zeros((4096, 8))})}
     Snapshot(str(tmp_path / "snap")).restore(target)
     assert calls  # the big buffer actually took the chunked path

@@ -210,9 +210,10 @@ def test_split_read_on_fake_gcs(monkeypatch):
 
 def test_streaming_split_puts_subranges_eagerly(tmp_path, monkeypatch):
     """A large dense entry restored into a jax template must STREAM:
-    one chunked_device_put per completed sub-range (overlapping reads
-    with H2D) rather than one put after full host reassembly."""
-    import torchsnapshot_tpu.io_preparer as iop
+    one overlap-engine submission per completed sub-range (overlapping
+    reads with H2D on the engine's transfer threads) rather than one
+    put after full host reassembly."""
+    from torchsnapshot_tpu.ops.transfer import H2DPipeline
 
     rng = np.random.default_rng(3)
     arr = jnp.asarray(rng.standard_normal((64, 64)), dtype=jnp.float32)
@@ -221,13 +222,13 @@ def test_streaming_split_puts_subranges_eagerly(tmp_path, monkeypatch):
     Snapshot.take(path, {"m": _Holder({"w": arr})})
 
     calls = []
-    orig = iop.chunked_device_put
+    orig_submit = H2DPipeline.submit
 
-    def spy(buf, device):
-        calls.append(len(buf) * buf.dtype.itemsize if hasattr(buf, "dtype") else len(buf))
-        return orig(buf, device)
+    def spy(self, host, device, profile=None):
+        calls.append(int(getattr(host, "nbytes", len(host))))
+        return orig_submit(self, host, device, profile=profile)
 
-    monkeypatch.setattr(iop, "chunked_device_put", spy)
+    monkeypatch.setattr(H2DPipeline, "submit", spy)
     target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
     Snapshot(path).restore(target)
     np.testing.assert_array_equal(np.asarray(target["m"].sd["w"]), arr)

@@ -290,11 +290,12 @@ def test_restore_report_carries_reconciling_consume_breakdown(
     substeps = profile["substeps"]
     assert profile["bytes"] > 0
     # Acceptance: the in-consume sub-steps (``other`` included) sum to
-    # the consume wall exactly; read_wait sits beside them.
+    # the consume wall exactly; read_wait and h2d_overlap (the overlap
+    # engine's transfer seconds) sit beside them.
     in_consume = sum(
         entry["seconds"]
         for name, entry in substeps.items()
-        if name != "read_wait"
+        if name not in ("read_wait", "h2d_overlap", "overlap_other")
     )
     assert in_consume == pytest.approx(profile["consume_s"], abs=1e-3)
     assert "read_wait" in substeps
@@ -414,7 +415,7 @@ def test_concurrent_restores_do_not_cross_attribute_profiles():
         in_consume = sum(
             e["seconds"]
             for n, e in profile["substeps"].items()
-            if n != "read_wait"
+            if n not in ("read_wait", "h2d_overlap", "overlap_other")
         )
         # Cross-attribution would break the per-restore reconciliation
         # (one report absorbing the other's sub-step seconds).
@@ -480,7 +481,10 @@ def test_doctor_names_dominant_substep_with_specific_remediation():
         f for f in findings if f.rule == "consume-dominated-restore"
     )
     assert finding.evidence["dominant_substep"] == "device_put"
-    assert "h2d_probe_gbps" in finding.remediation
+    # Post-fastlane advice: device_put dominating consume means the
+    # overlap engine is not engaging — the remediation names the
+    # streaming pipeline's tuning envs.
+    assert "TPUSNAPSHOT_H2D_DEPTH" in finding.remediation
 
 
 # ------------------------------------------------------- multi-process merge

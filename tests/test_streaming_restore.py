@@ -62,14 +62,16 @@ def test_chunked_dense_restore_streams_across_objects(
     """Every chunk object of a format-chunked dense array must stream to
     device as its sub-ranges land (no host assembly buffer), and the
     flat-offset concat must be bit-exact."""
+    from torchsnapshot_tpu.ops.transfer import H2DPipeline
+
     puts = []
-    real_put = iop.chunked_device_put
+    orig_submit = H2DPipeline.submit
 
-    def _spy_put(host, device):
+    def _spy_put(self, host, device, profile=None):
         puts.append(int(getattr(host, "nbytes", 0)))
-        return real_put(host, device)
+        return orig_submit(self, host, device, profile=profile)
 
-    monkeypatch.setattr(iop, "chunked_device_put", _spy_put)
+    monkeypatch.setattr(H2DPipeline, "submit", _spy_put)
 
     arr = _arr(4 << 20, seed=1)  # 4 chunks x 4 sub-reads
     path = str(tmp_path / "snap")

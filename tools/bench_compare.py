@@ -20,6 +20,10 @@ Compared metrics:
 - ``take_vs_ceiling`` / ``restore_vs_ceiling`` (ceiling-relative
   ratios, robust to the two runs landing on different hardware),
   higher is better
+- ``restore_vs_h2d_ceiling`` (the streaming restore pipeline's
+  overlap-engine H2D GB/s over the bracketed H2D ceiling — the
+  fastlane's "wire-bound, not consume-bound" certificate), higher is
+  better
 - ``hot_tier.hot_vs_durable`` (the hot-vs-durable restore ratio the
   hot tier certifies), higher is better
 - ``hot_tier.durability_lag_s`` (the bench take's measured
@@ -59,6 +63,11 @@ _METRICS: List[Tuple[str, str, str]] = [
     ("restore_GBps", "restore GB/s", "high"),
     ("take_vs_ceiling", "take/ceiling", "high"),
     ("restore_vs_ceiling", "restore/ceiling", "high"),
+    # Streaming restore fast path (fastlane): the overlap engine's
+    # delivered H2D GB/s over the bracketed H2D ceiling. ~1.0 means the
+    # wire, not the consumer, bounds the restore; a regression back
+    # toward a consume-serialized restore drops it.
+    ("restore_vs_h2d_ceiling", "restore H2D/ceiling", "high"),
     ("hot_tier.hot_vs_durable", "hot/durable ratio", "high"),
     ("hot_tier.durability_lag_s", "durability lag s", "low"),
     ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
@@ -237,7 +246,10 @@ def _consume_profile_notes(
             {
                 name: float(entry.get("seconds") or 0.0) / wall
                 for name, entry in subs.items()
-                if name != "read_wait"
+                # Beside-the-wall sub-steps (scheduler queueing, the
+                # overlap engine's transfers) are not shares of the
+                # consume wall.
+                if name not in ("read_wait", "h2d_overlap", "overlap_other")
             }
         )
     notes: List[str] = []
@@ -284,6 +296,18 @@ def _self_test() -> int:
     assert not reg, f"15% drop is within the 20% threshold: {reg}"
     _, reg = compare(base, dict(base, restore_GBps=None), 0.2)
     assert not reg, f"missing metric must be skipped, not failed: {reg}"
+    # Fastlane sentinel: the streaming pipeline's H2D/ceiling fraction
+    # regresses on a drop (a slide back toward serialized consume);
+    # absent on either side = skipped (pre-fastlane artifacts).
+    fast = dict(base, restore_vs_h2d_ceiling=0.95)
+    _, reg = compare(fast, dict(fast), 0.2)
+    assert not reg, f"identical fastlane runs must pass: {reg}"
+    _, reg = compare(fast, dict(fast, restore_vs_h2d_ceiling=0.5), 0.2)
+    assert reg and "restore H2D/ceiling" in reg[0], (
+        f"H2D-fraction halving must fail: {reg}"
+    )
+    _, reg = compare(base, fast, 0.2)
+    assert not reg, f"fastlane key absent on one side is skipped: {reg}"
     _, reg = compare({"value": None}, {"value": 1.0}, 0.2)
     assert not reg, "null baseline must be skipped"
     lines, _ = compare(

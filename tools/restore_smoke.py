@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Restore-throughput smoke for CI: the streaming fast path must keep
+consume off the critical path.
+
+A small CPU payload restores through the full streaming pipeline
+(forced-small split threshold so the overlap engine engages), then the
+flight report is held to the fastlane's structural contract:
+
+- consume wall <= a small multiple of read wall (a regression back to
+  a consume-serialized restore fails HERE instead of waiting for a
+  bench round to notice a 176 s consume span);
+- every payload byte crossed on the overlap engine (``h2d_overlap``),
+  with NO device_put inside the consume executors;
+- the in-consume sub-steps still reconcile exactly against the
+  consume wall.
+
+Exit 0 on success, 1 on any violated contract. Runs in a few seconds
+on CPU (JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+# Keep the smoke hermetic before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "TPUSNAPSHOT_PARALLEL_READ_THRESHOLD", str(1 << 20)
+)
+
+# Runnable as `python tools/restore_smoke.py` from the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchsnapshot_tpu import Snapshot  # noqa: E402
+
+# Consume wall may legitimately exceed read wall on a local fs (reads
+# are page-cache fast) — but a STREAMING consume is submit+crc only, so
+# a small multiple holds; the absolute floor keeps sub-second jitter
+# from failing the gate.
+CONSUME_VS_READ_MULTIPLE = 5.0
+CONSUME_FLOOR_S = 1.0
+PAYLOAD_BYTES = 48 << 20
+
+
+class _Holder:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def state_dict(self):
+        return self.sd
+
+    def load_state_dict(self, sd):
+        self.sd = sd
+
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    arr = jnp.asarray(
+        rng.standard_normal(PAYLOAD_BYTES // 4), jnp.float32
+    )
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="restore-smoke-") as d:
+        root = os.path.join(d, "snap")
+        Snapshot.take(root, {"m": _Holder({"w": arr})})
+        target = {"m": _Holder({"w": jnp.zeros_like(arr)})}
+        Snapshot(root).restore(target)
+        if not np.array_equal(
+            np.asarray(target["m"].sd["w"]), np.asarray(arr)
+        ):
+            print("FAIL: restored payload is not bit-exact")
+            return 1
+        with open(os.path.join(root, ".report.restore.json")) as f:
+            report = json.load(f)
+    rank = next(s for s in report["ranks"] if s)
+    phases = rank.get("phases") or {}
+    read_s = float(phases.get("read_s") or 0.0)
+    consume_s = float(phases.get("consume_s") or 0.0)
+    profile = rank.get("consume_profile") or {}
+    substeps = profile.get("substeps") or {}
+    overlap = substeps.get("h2d_overlap") or {}
+
+    bound = max(CONSUME_VS_READ_MULTIPLE * read_s, CONSUME_FLOOR_S)
+    if consume_s > bound:
+        failures.append(
+            f"consume wall {consume_s:.3f}s exceeds "
+            f"max({CONSUME_VS_READ_MULTIPLE:g} x read {read_s:.3f}s, "
+            f"{CONSUME_FLOOR_S:g}s) — the restore is consume-bound "
+            f"again"
+        )
+    if overlap.get("bytes", 0) != arr.nbytes:
+        failures.append(
+            f"h2d_overlap carried {overlap.get('bytes', 0)} bytes, "
+            f"expected the full {arr.nbytes}-byte payload — transfers "
+            f"are not riding the overlap engine"
+        )
+    in_consume_put = (substeps.get("device_put") or {}).get("bytes", 0)
+    if in_consume_put:
+        failures.append(
+            f"{in_consume_put} bytes of device_put ran INSIDE consume "
+            f"executors — the streaming fast path is not engaging"
+        )
+    accounted = sum(
+        e.get("seconds", 0.0)
+        for n, e in substeps.items()
+        if n not in ("read_wait", "h2d_overlap", "overlap_other")
+    )
+    if abs(accounted - float(profile.get("consume_s") or 0.0)) > 1e-3:
+        failures.append(
+            f"consume sub-steps ({accounted:.4f}s) do not reconcile "
+            f"with the consume wall ({profile.get('consume_s')}s)"
+        )
+    print(
+        f"restore smoke: read {read_s:.3f}s, consume {consume_s:.3f}s, "
+        f"h2d_overlap {overlap.get('seconds', 0):.3f}s/"
+        f"{overlap.get('bytes', 0)} B "
+        f"({profile.get('h2d_overlap_gbps', 0)} GB/s)"
+    )
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("restore smoke OK: consume stays off the critical path")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -825,7 +825,8 @@ def decode_and_verify_chunk(
     dtype_name: str,
     stored: Any,
     profile: Any = None,
-) -> bytes:
+    out: Optional[memoryview] = None,
+) -> Optional[bytes]:
     """Decode one stored content chunk and verify its integrity —
     shared by the restore pipeline, ``Snapshot.verify``, and
     ``copy_to`` materialization so they can never disagree.
@@ -841,7 +842,15 @@ def decode_and_verify_chunk(
     ChunkStager's unsuitable-payload degrade) — the fingerprint check
     still gates the bytes. ``profile`` (a
     ``telemetry.consume_profile.ConsumeProfile``, or None) splits the
-    chunk's decode vs verify cost for the restore micro-profiler."""
+    chunk's decode vs verify cost for the restore micro-profiler.
+
+    ``out`` (an exactly-``n``-byte writable memoryview, or None) is the
+    streaming fast path's zero-copy hand-off: identity-stored chunks
+    are verified against the content key and copied ONCE into ``out``
+    (returning None); codec chunks still decode to a transient and are
+    returned for the caller to splice. Without ``out`` the decoded
+    bytes are always returned — the pre-fastlane contract that
+    ``verify``/``copy_to`` keep using."""
     from .fingerprint import fingerprint_host
     from .serialization import verify_checksum
     from .telemetry import consume_profile as _cprof
@@ -869,6 +878,47 @@ def decode_and_verify_chunk(
                 verify_checksum(stored, rec.get("cs"))
         except Exception as e:
             stale_note = str(e)
+    if out is not None and (codec is None or codec == "identity"):
+        # Zero-copy fast path: identity chunks verify against the
+        # content key on the STORED view and land in the caller's
+        # assembly buffer with exactly one memcpy — no per-chunk
+        # transient (the pre-fastlane flow copied twice: identity
+        # decode + splice).
+        if len(stored) != logical_n:
+            raise RuntimeError(
+                f"content chunk {key}: decoded {len(stored)} bytes, "
+                f"expected {logical_n}"
+                + (
+                    f" (recorded-bytes mismatch: {stale_note})"
+                    if stale_note
+                    else ""
+                )
+            )
+        expected_fp = key.rsplit("-", 2)[0]
+        with _cprof.substep(profile, "verify", logical_n):
+            actual_fp = fingerprint_host(stored)
+        if actual_fp != expected_fp:
+            raise RuntimeError(
+                f"content chunk {key}: stored bytes decode to content "
+                f"fingerprinting as {actual_fp} — the store object is "
+                f"corrupt or mis-addressed"
+                + (
+                    f" (recorded-bytes mismatch: {stale_note})"
+                    if stale_note
+                    else ""
+                )
+            )
+        if stale_note:
+            logger.warning(
+                f"content chunk {key}: recorded stored-size/crc do not "
+                f"match the object ({stale_note}) but content "
+                f"verification passed — likely a concurrent same-key "
+                f"writer with a different encoder; serving the "
+                f"verified bytes"
+            )
+        with _cprof.substep(profile, "reassemble", logical_n):
+            out[:logical_n] = stored
+        return None
     try:
         with _cprof.substep(profile, "decode", len(stored)):
             logical = codecs.decode(codec, stored, dtype_name)

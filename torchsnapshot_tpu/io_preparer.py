@@ -44,13 +44,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import tracing
+from . import staging_pool, tracing
 from .telemetry import consume_profile as _cprof
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from .utils.env import env_int
 from .ops.transfer import (
     chunked_device_put,
     device_clone,
+    h2d_chunk_bytes,
+    h2d_pipeline,
     parallel_device_get,
     should_chunk_h2d,
     should_chunk_transfer,
@@ -171,6 +173,33 @@ def _is_partitioned(arr: jax.Array) -> bool:
 # aliases keep this module's call sites short.
 _should_chunk_transfer = should_chunk_transfer
 _parallel_device_get = parallel_device_get
+
+
+# Finalize executor: an eager finalize triggered from an H2D engine
+# done-callback must NOT run on the engine worker itself —
+# _await_pipeline blocks on futures queued on that same depth-limited
+# pool, and at depth 1 (or N concurrent restores ≥ depth) the worker
+# would wait on work only it can run. Engine-triggered finalizes hop
+# here instead; the pool only ever waits ON the engine, never the
+# reverse, so there is no cycle.
+_finalize_pool: Optional[Any] = None
+_finalize_pool_lock = threading.Lock()
+
+
+def _get_finalize_pool():
+    global _finalize_pool
+    with _finalize_pool_lock:
+        if _finalize_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _finalize_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tpusnapshot-finalize"
+            )
+        return _finalize_pool
+
+
+def _on_h2d_engine_thread() -> bool:
+    return threading.current_thread().name.startswith("tpusnapshot-h2d")
 
 
 class ArrayBufferStager(BufferStager):
@@ -388,15 +417,18 @@ class ObjectBufferConsumer(BufferConsumer):
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _load() -> Any:
-            with _cprof.substep(self._profile, "verify", len(buf)):
-                verify_checksum(buf, self._checksum)
-            if self._compression is not None:
-                with _cprof.substep(self._profile, "decode", len(buf)):
-                    raw = decompress_payload(buf, self._compression)
-            else:
-                raw = buf
-            with _cprof.substep(self._profile, "deserialize", len(raw)):
-                return bytes_to_object(raw)
+            with _cprof.consume_section():
+                with _cprof.substep(self._profile, "verify", len(buf)):
+                    verify_checksum(buf, self._checksum)
+                if self._compression is not None:
+                    with _cprof.substep(self._profile, "decode", len(buf)):
+                        raw = decompress_payload(buf, self._compression)
+                else:
+                    raw = buf
+                with _cprof.substep(
+                    self._profile, "deserialize", len(raw)
+                ):
+                    return bytes_to_object(raw)
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -411,14 +443,35 @@ class ObjectBufferConsumer(BufferConsumer):
 
 class _TargetRegion:
     """One distinct region of the global array needed on restore, with the
-    devices that need it (replicas share one host buffer)."""
+    devices that need it (replicas share one host buffer).
 
-    def __init__(self, offsets: List[int], sizes: List[int], dtype: np.dtype):
+    The host buffer is LAZY and (for device-template restores) pooled:
+    it materializes from the staging pool on the first scatter into it,
+    so regions that end up streaming to device or adopting a zero-copy
+    payload view never allocate one, and the ones that do allocate
+    reuse a prior restore's buffer of the same size."""
+
+    def __init__(
+        self,
+        offsets: List[int],
+        sizes: List[int],
+        dtype: np.dtype,
+        poolable: bool = False,
+    ):
         self.offsets = offsets
         self.sizes = sizes
+        self.dtype = np.dtype(dtype)
         self.devices: List[Any] = []
-        self.nbytes = int(np.dtype(dtype).itemsize * np.prod(sizes))
-        self.buffer = np.empty(sizes, dtype=dtype)
+        self.nbytes = int(self.dtype.itemsize * np.prod(sizes))
+        # Lazily materialized host buffer (None until first needed). A
+        # zero-copy adoption replaces it with a read-payload view
+        # without ever touching the pool; host-template restores
+        # allocate plain arrays (the buffer is handed to the app, so
+        # pool reuse would alias user memory).
+        self.buffer: Optional[np.ndarray] = None
+        self._poolable = poolable
+        self._lease: Optional[staging_pool.StagingLease] = None
+        self._buf_lock = threading.Lock()
         # Whether the scheduler's device budget already holds this
         # region's reservation (charged once, by the first admitted
         # streaming sub-read; the unit of HBM occupancy is the region —
@@ -434,6 +487,40 @@ class _TargetRegion:
         # deposited chunks are concatenated and freed — returns the
         # streamed bytes to the scheduler's device-memory budget.
         self.device_releases: List[Tuple[Callable[[int], None], int]] = []
+        # Chunk-copies still expected to scatter into this region; set
+        # by the plan at build time. When the count drains the plan may
+        # dispatch this region's H2D on the overlap engine instead of
+        # waiting for plan finalize (chunk-granular overlap).
+        self.pending_copies = 0
+        # Future from the overlap engine's early dispatch (single-
+        # device regions); finalize collects it instead of device_put.
+        self.early_put: Optional[Any] = None
+
+    def ensure_buffer(self, profile: Optional[Any] = None) -> np.ndarray:
+        with self._buf_lock:
+            if self.buffer is None:
+                pool = (
+                    staging_pool.get_staging_pool()
+                    if self._poolable
+                    else None
+                )
+                if pool is not None:
+                    self._lease = pool.acquire(self.nbytes, profile)
+                    self.buffer = self._lease.as_array(
+                        self.dtype, list(self.sizes)
+                    )
+                else:
+                    self.buffer = np.empty(self.sizes, dtype=self.dtype)
+            return self.buffer
+
+    def release_lease(self) -> None:
+        """Return the pooled backing (if any) — only safe once no
+        pending transfer still reads from ``buffer``."""
+        with self._buf_lock:
+            lease, self._lease = self._lease, None
+            self.buffer = None if lease is not None else self.buffer
+        if lease is not None:
+            lease.release()
 
 
 class _ChunkCopyConsumer(BufferConsumer):
@@ -448,6 +535,8 @@ class _ChunkCopyConsumer(BufferConsumer):
         checksum: Optional[str] = None,
         compression: Optional[str] = None,
         on_done: Optional[Callable[[], None]] = None,
+        allow_adopt: bool = True,
+        region_notify: Optional[Callable[[_TargetRegion], None]] = None,
     ) -> None:
         # copies: (region, region_slices, view_slices)
         self._view_shape = view_shape
@@ -456,6 +545,15 @@ class _ChunkCopyConsumer(BufferConsumer):
         self._checksum = checksum
         self._compression = compression
         self._on_done = on_done
+        # False when the payload handed to consume_buffer is a view over
+        # a POOLED assembly buffer (split/content-chunk read states):
+        # adopting such a view would pin pool memory past its release
+        # and corrupt a later restore that reuses it.
+        self._allow_adopt = allow_adopt
+        # Plan hook: fired once per (this chunk, region) scatter so the
+        # plan can early-dispatch a fully-populated region's H2D on the
+        # overlap engine instead of waiting for finalize.
+        self._region_notify = region_notify
         self._cost = int(np.dtype(dtype).itemsize * np.prod(view_shape))
         self._profile = _cprof.current()
 
@@ -476,13 +574,13 @@ class _ChunkCopyConsumer(BufferConsumer):
                 )
                 for region, region_slices, view_slices in self._copies:
                     if (
-                        len(self._copies) == 1
-                        and view.shape == region.buffer.shape
+                        self._allow_adopt
+                        and len(self._copies) == 1
+                        and region.buffer is None
+                        and list(view.shape) == list(region.sizes)
                         and all(
                             sl.start == 0 and sl.stop == dim
-                            for sl, dim in zip(
-                                region_slices, region.buffer.shape
-                            )
+                            for sl, dim in zip(region_slices, region.sizes)
                         )
                         and all(
                             sl.start == 0 and sl.stop == dim
@@ -490,20 +588,26 @@ class _ChunkCopyConsumer(BufferConsumer):
                         )
                     ):
                         # The chunk exactly covers this region: adopt the
-                        # zero-copy view instead of memcpy-ing into the
-                        # preallocated buffer (np.frombuffer views are
+                        # zero-copy view instead of memcpy-ing into a
+                        # staging buffer (np.frombuffer views are
                         # read-only, which device_put accepts).
                         region.buffer = view
                     else:
-                        region.buffer[region_slices] = view[view_slices]
+                        region.ensure_buffer(self._profile)[
+                            region_slices
+                        ] = view[view_slices]
 
         def _copy_and_signal() -> None:
-            _copy()
-            # Runs in the executor thread: a finalize triggered here (host→
-            # device assembly) overlaps with reads still in flight instead
-            # of blocking the event loop.
-            if self._on_done is not None:
-                self._on_done()
+            with _cprof.consume_section():
+                _copy()
+                if self._region_notify is not None:
+                    for region, _rs, _vs in self._copies:
+                        self._region_notify(region)
+                # Runs in the executor thread: a finalize triggered here
+                # (host→device assembly) overlaps with reads still in
+                # flight instead of blocking the event loop.
+                if self._on_done is not None:
+                    self._on_done()
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -515,7 +619,70 @@ class _ChunkCopyConsumer(BufferConsumer):
         return self._cost
 
 
-class _SplitObjectReadState:
+class _PooledAssemblyState:
+    """Shared lease/budget plumbing for read states that assemble ONE
+    stored object in a host buffer drawn from the staging pool
+    (``staging_pool.py``): the scheduler's deferred-cost releaser
+    (charged as the first sub-read's/chunk's deferred cost) is
+    re-credited exactly ONCE — when the buffer actually returns to the
+    pool — whichever of buffer acquisition and the scheduler's
+    dispatch hook lands first, so concurrent reads cannot overrun the
+    budget and a pooled, multi-sub-read buffer cannot over-credit it.
+    One implementation, two subclasses: the split whole-object path and
+    the content-chunk (chunkstore) path must never diverge on this
+    contract."""
+
+    def __init__(self, nbytes: int) -> None:
+        self.nbytes = nbytes
+        self._buf: Optional[bytearray] = None  # allocated on first absorb
+        self._lease: Optional[staging_pool.StagingLease] = None
+        self._lock = threading.Lock()
+        self._profile = _cprof.current()
+        self._cost_release: Optional[Callable[[int], None]] = None
+
+    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
+        with self._lock:
+            lease = self._lease
+            if lease is None:
+                self._cost_release = release
+        if lease is not None:
+            # Acquisition raced ahead of the scheduler's dispatch hook:
+            # hand the credit to the lease (fired once, at release).
+            lease.set_budget_release(release, self.nbytes)
+
+    def _ensure_buf(self) -> None:
+        """Materialize the shared assembly buffer (pooled when the
+        staging pool is enabled; the lease then carries the budget
+        re-credit and fires it exactly once at pool return)."""
+        with self._lock:
+            if self._buf is not None:
+                return
+            pool = staging_pool.get_staging_pool()
+            if pool is None:
+                self._buf = bytearray(self.nbytes)
+                return
+            lease = pool.acquire(self.nbytes, self._profile)
+            release, self._cost_release = self._cost_release, None
+            self._lease = lease
+            self._buf = lease.buffer
+        if release is not None:
+            lease.set_budget_release(release, self.nbytes)
+
+    def _release_assembly_buffer(self) -> None:
+        """Free the assembly buffer: pooled buffers return to the pool
+        (which fires the budget re-credit once); plain ones re-credit
+        through the releaser directly. Idempotent either way."""
+        with self._lock:
+            lease, self._lease = self._lease, None
+            release, self._cost_release = self._cost_release, None
+            self._buf = None
+        if lease is not None:
+            lease.release()
+        elif release is not None:
+            release(self.nbytes)
+
+
+class _SplitObjectReadState(_PooledAssemblyState):
     """Reassembles concurrent ranged sub-reads of ONE stored object into
     a single host buffer, then runs the real consumer on the whole
     payload. Checksum verification still covers the complete object (the
@@ -524,20 +691,9 @@ class _SplitObjectReadState:
     reads, which skip verification."""
 
     def __init__(self, nbytes: int, inner: BufferConsumer) -> None:
-        self.nbytes = nbytes
+        super().__init__(nbytes)
         self._inner = inner
-        self._buf: Optional[bytearray] = None  # allocated on first absorb
         self._remaining = 0
-        self._lock = threading.Lock()
-        self._profile = _cprof.current()
-        # Scheduler budget-release callback for the shared assembly
-        # reservation (charged as the first sub-read's deferred cost,
-        # re-credited only here — when the buffer is actually freed —
-        # so concurrent split reads cannot overrun the read budget).
-        self._cost_release: Optional[Callable[[int], None]] = None
-
-    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
-        self._cost_release = release
 
     def extra_first_cost_bytes(self) -> int:
         """Cost charged on top of the first sub-read's payload: the
@@ -549,11 +705,6 @@ class _SplitObjectReadState:
         outlives its consume: the assembly buffer, carried by the first
         sub-read, freed when the LAST one lands."""
         return self.nbytes if first else 0
-
-    def _release_assembly_cost(self) -> None:
-        release, self._cost_release = self._cost_release, None
-        if release is not None:
-            release(self.nbytes)
 
     def add_sub_reads(self, path: str, part_size: int) -> List[ReadReq]:
         reqs = []
@@ -580,20 +731,19 @@ class _SplitObjectReadState:
         executor: Optional[Executor] = None,
     ) -> None:
         def _copy() -> None:
-            with _cprof.substep(
-                self._profile, "reassemble", end - start
-            ):
-                with self._lock:
-                    if self._buf is None:
-                        self._buf = bytearray(self.nbytes)
-                if len(buf) != end - start:
-                    raise RuntimeError(
-                        f"Ranged sub-read returned {len(buf)} bytes for "
-                        f"[{start}, {end}) — object shorter than the manifest "
-                        f"implies (truncated or torn)."
-                    )
-                # Disjoint ranges: concurrent executor threads never overlap.
-                memoryview(self._buf)[start:end] = buf
+            with _cprof.consume_section():
+                self._ensure_buf()
+                with _cprof.substep(
+                    self._profile, "reassemble", end - start
+                ):
+                    if len(buf) != end - start:
+                        raise RuntimeError(
+                            f"Ranged sub-read returned {len(buf)} bytes for "
+                            f"[{start}, {end}) — object shorter than the manifest "
+                            f"implies (truncated or torn)."
+                        )
+                    # Disjoint ranges: concurrent executor threads never overlap.
+                    memoryview(self._buf)[start:end] = buf
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -605,13 +755,16 @@ class _SplitObjectReadState:
             last = self._remaining == 0
         if last:
             try:
-                await self._inner.consume_buffer(memoryview(self._buf), executor)
+                await self._inner.consume_buffer(
+                    memoryview(self._buf)[: self.nbytes], executor
+                )
             finally:
-                with _cprof.substep(
+                with _cprof.consume_section(), _cprof.substep(
                     self._profile, "staging_release", self.nbytes
                 ):
-                    self._buf = None  # free eagerly
-                    self._release_assembly_cost()
+                    # Pool return fires the scheduler budget re-credit
+                    # exactly once, however many sub-reads shared it.
+                    self._release_assembly_buffer()
 
 
 class _StreamingSplitState(_SplitObjectReadState):
@@ -621,6 +774,14 @@ class _StreamingSplitState(_SplitObjectReadState):
     reassemble-then-put split serializes (measured: a pure 640 MiB
     restore reached only 0.74 of the bracketed H2D ceiling because the
     last sub-read gated the entire device transfer).
+
+    Fastlane: the H2D itself runs on the overlap ENGINE
+    (ops/transfer.py H2DPipeline), not inside the consume executor — a
+    consume here is only the length check, the incremental crc fold,
+    and the transfer submit, so consume wall tracks host work while the
+    double-buffered engine keeps the link saturated. The engine's
+    done-callback deposits the device chunk and fires the plan's
+    on_done once every part has BOTH crc-verified and landed on device.
 
     Only used when one uncompressed chunk exactly covers one
     single-device region (the dominant shape: restoring a large dense
@@ -640,6 +801,7 @@ class _StreamingSplitState(_SplitObjectReadState):
         checksum: Optional[str],
         on_done: Callable[[], None],
         flat_base: int = 0,
+        register_transfer: Optional[Callable[[Any], None]] = None,
     ) -> None:
         super().__init__(nbytes, inner=None)  # inner unused
         self._region = region
@@ -667,6 +829,17 @@ class _StreamingSplitState(_SplitObjectReadState):
         self._released = 0  # deferred bytes already re-credited
         self._device_release: Optional[Callable[[int], None]] = None
         self._deposited = 0  # device bytes charged by the scheduler
+        # Plan hook: every engine future is registered so finalize can
+        # surface a transfer failure before publishing anything.
+        self._register_transfer = register_transfer
+        # Per-part budget refcounts: a part's payload is re-credited
+        # only after BOTH holds drop — the crc prefix drain (the
+        # out-of-order stash) and the overlap engine's transfer.
+        self._part_refs: Dict[int, int] = {}
+        self._transfers_remaining = 0
+        self._crc_ok = self._crc is None
+        self._completed = False
+        self._failed = False
 
     def set_device_cost_releaser(self, release: Callable[[int], None]) -> None:
         self._device_release = release
@@ -683,14 +856,20 @@ class _StreamingSplitState(_SplitObjectReadState):
         return 0
 
     def deferred_cost_bytes(self, first: bool, part_nbytes: int) -> int:
-        # With an incremental crc, an out-of-order part is stashed on
-        # host until its prefix drains — its payload outlives the
-        # consume. Released per-part from the drain loop.
-        return part_nbytes if self._crc is not None else 0
+        # Every part's payload outlives its consume: the overlap engine
+        # holds it until the transfer completes, and (with an
+        # incremental crc) the out-of-order stash may hold it until the
+        # prefix drains. Released per-part once both holds drop.
+        return part_nbytes
+
+    def add_sub_reads(self, path: str, part_size: int) -> List[ReadReq]:
+        reqs = super().add_sub_reads(path, part_size)
+        self._transfers_remaining = len(reqs)
+        return reqs
 
     def _release_assembly_cost(self) -> None:
-        # Error-path safety net: re-credit whatever the drain loop has
-        # not already released (on success the final drain covers the
+        # Error-path safety net: re-credit whatever the per-part
+        # refcounts have not already released (on success they cover the
         # whole object and this is a no-op).
         release, self._cost_release = self._cost_release, None
         if release is not None:
@@ -700,6 +879,72 @@ class _StreamingSplitState(_SplitObjectReadState):
             if remaining > 0:
                 release(remaining)
 
+    def _part_release(self, start: int, nbytes: int) -> None:
+        release = None
+        with self._lock:
+            refs = self._part_refs.get(start)
+            if refs is None:
+                return
+            refs -= 1
+            if refs:
+                self._part_refs[start] = refs
+                return
+            del self._part_refs[start]
+            release = self._cost_release
+            if release is not None:
+                self._released += nbytes
+        if release is not None:
+            release(nbytes)
+
+    def _transfer_done(self, start: int, nbytes: int, fut: Any) -> None:
+        if fut.cancelled() or fut.exception() is not None:
+            # The restore is failing: finalize (or the plan's safety
+            # net) re-raises the registered future's error before
+            # anything is published. Mark failed so on_done never fires
+            # over a partial deposit — and release the stream's
+            # remaining deferred-budget holds NOW, so the doomed
+            # restore's other reads don't crawl through forced
+            # admission against a starved budget until the finalizer
+            # surfaces the error.
+            with self._lock:
+                self._failed = True
+            self._release_assembly_cost()
+            return
+        # Deposit straight into the region, keyed by region-flat byte
+        # offset (distinct keys across all of the region's chunk
+        # streams; GIL-atomic dict write). The chunks stay unreachable
+        # to the application until the plan's finalize assembles them —
+        # which only runs after every chunk's crc verified.
+        self._region.device_chunks[self._flat_base + start] = fut.result()
+        with self._lock:
+            self._transfers_remaining -= 1
+        self._part_release(start, nbytes)
+        self._maybe_complete()
+
+    def _maybe_complete(self) -> None:
+        with self._lock:
+            if (
+                self._completed
+                or self._failed
+                or not self._crc_ok
+                or self._remaining != 0
+                or self._transfers_remaining != 0
+            ):
+                return
+            self._completed = True
+            # Hand the scheduler's device-budget reservation to the
+            # region: finalize releases it once the concat frees the
+            # per-chunk arrays.
+            if self._device_release is not None and self._deposited:
+                self._region.device_releases.append(
+                    (self._device_release, self._deposited)
+                )
+                self._device_release = None
+        try:
+            self._on_done()
+        finally:
+            self._release_assembly_cost()
+
     async def absorb(
         self,
         start: int,
@@ -707,71 +952,69 @@ class _StreamingSplitState(_SplitObjectReadState):
         buf: BufferType,
         executor: Optional[Executor] = None,
     ) -> None:
-        def _consume_part() -> Any:
-            if len(buf) != end - start:
-                raise RuntimeError(
-                    f"Ranged sub-read returned {len(buf)} bytes for "
-                    f"[{start}, {end}) — object shorter than the manifest "
-                    f"implies (truncated or torn)."
+        def _consume_part() -> None:
+            with _cprof.consume_section():
+                if len(buf) != end - start:
+                    raise RuntimeError(
+                        f"Ranged sub-read returned {len(buf)} bytes for "
+                        f"[{start}, {end}) — object shorter than the manifest "
+                        f"implies (truncated or torn)."
+                    )
+                flat = np.frombuffer(buf, dtype=self._np_dtype)
+                with self._lock:
+                    self._part_refs[start] = (
+                        2 if self._crc is not None else 1
+                    )
+                # Submit the H2D on the overlap engine FIRST: the
+                # transfer rides the link while the crc fold below runs
+                # on host and later sub-reads are still arriving.
+                fut = h2d_pipeline().submit(
+                    flat, self._device, profile=self._profile
                 )
-            flat = np.frombuffer(buf, dtype=self._np_dtype)
-            # Eager H2D first: the transfer rides the link while later
-            # sub-reads are still arriving from storage.
-            with _cprof.substep(self._profile, "device_put", len(buf)):
-                dev = chunked_device_put(flat, self._device)
-            if self._crc is not None:
-                with _cprof.substep(self._profile, "verify", len(buf)):
-                    drained = 0
-                    with self._lock:
-                        self._stash[start] = buf
-                        while self._next_off in self._stash:
-                            b = self._stash.pop(self._next_off)
-                            self._crc.update(b)
-                            self._next_off += len(b)
-                            drained += len(b)
-                        release = self._cost_release
-                        if release is not None and drained:
-                            self._released += drained
-                # Re-credit drained parts outside the state lock (the
-                # budget cell takes its own lock).
-                if release is not None and drained:
-                    release(drained)
-            return dev
+                if self._register_transfer is not None:
+                    self._register_transfer(fut)
+                fut.add_done_callback(
+                    lambda f, s=start, n=len(buf): self._transfer_done(
+                        s, n, f
+                    )
+                )
+                if self._crc is not None:
+                    with _cprof.substep(self._profile, "verify", len(buf)):
+                        drained: List[Tuple[int, int]] = []
+                        with self._lock:
+                            self._stash[start] = buf
+                            while self._next_off in self._stash:
+                                off = self._next_off
+                                b = self._stash.pop(off)
+                                self._crc.update(b)
+                                self._next_off += len(b)
+                                drained.append((off, len(b)))
+                            stream_done = self._next_off >= self.nbytes
+                        # Re-credit drained parts outside the state lock
+                        # (the budget cell takes its own lock).
+                        for off, n in drained:
+                            self._part_release(off, n)
+                        if stream_done:
+                            actual = self._crc.tag()
+                            if actual != self._checksum:
+                                with self._lock:
+                                    self._failed = True
+                                raise RuntimeError(
+                                    f"Checksum mismatch: stored object is "
+                                    f"corrupt (expected {self._checksum}, "
+                                    f"got {actual})."
+                                )
+                            with self._lock:
+                                self._crc_ok = True
 
         if executor is not None:
             loop = asyncio.get_running_loop()
-            dev = await loop.run_in_executor(executor, _consume_part)
+            await loop.run_in_executor(executor, _consume_part)
         else:
-            dev = _consume_part()
-        # Deposit straight into the region, keyed by region-flat byte
-        # offset (distinct keys across all of the region's chunk
-        # streams; GIL-atomic dict write). The chunks stay unreachable
-        # to the application until the plan's finalize assembles them —
-        # which only runs after every chunk's crc verified.
-        self._region.device_chunks[self._flat_base + start] = dev
+            _consume_part()
         with self._lock:
             self._remaining -= 1
-            last = self._remaining == 0
-        if last:
-            try:
-                if self._crc is not None:
-                    actual = self._crc.tag()
-                    if actual != self._checksum:
-                        raise RuntimeError(
-                            f"Checksum mismatch: stored object is corrupt "
-                            f"(expected {self._checksum}, got {actual})."
-                        )
-                # Hand the scheduler's device-budget reservation to the
-                # region: finalize releases it once the concat frees the
-                # per-chunk arrays.
-                if self._device_release is not None and self._deposited:
-                    self._region.device_releases.append(
-                        (self._device_release, self._deposited)
-                    )
-                    self._device_release = None
-                self._on_done()
-            finally:
-                self._release_assembly_cost()
+        self._maybe_complete()
 
 
 class _SubRangeConsumer(BufferConsumer):
@@ -856,7 +1099,7 @@ class _SubRangeConsumer(BufferConsumer):
         self._state.note_device_cost(region.nbytes)
 
 
-class _ContentChunksReadState:
+class _ContentChunksReadState(_PooledAssemblyState):
     """Reassembles the content-addressed chunks of ONE stored object
     (chunkstore.py manifest entries) into its logical payload, then
     runs the real consumer on the whole payload — the chunk-store
@@ -879,19 +1122,12 @@ class _ContentChunksReadState:
         dtype_name: str,
         store_base: Optional[int],
     ) -> None:
+        super().__init__(sum(int(r["n"]) for r in records))
         self._inner = inner
         self._records = records
         self._dtype_name = dtype_name
         self._store_base = store_base
-        self.nbytes = sum(int(r["n"]) for r in records)
-        self._buf: Optional[bytearray] = None
         self._remaining = len(records)
-        self._lock = threading.Lock()
-        self._cost_release: Optional[Callable[[int], None]] = None
-        self._profile = _cprof.current()
-
-    def set_cost_releaser(self, release: Callable[[int], None]) -> None:
-        self._cost_release = release
 
     def build_reads(self) -> List[ReadReq]:
         from .chunkstore import chunk_object_path
@@ -914,13 +1150,6 @@ class _ContentChunksReadState:
             offset += int(rec["n"])
         return reqs
 
-    def _decode_and_verify(self, rec: Dict[str, Any], buf: BufferType) -> bytes:
-        from .chunkstore import decode_and_verify_chunk
-
-        return decode_and_verify_chunk(
-            rec, self._dtype_name, buf, profile=self._profile
-        )
-
     async def absorb(
         self,
         rec: Dict[str, Any],
@@ -929,18 +1158,29 @@ class _ContentChunksReadState:
         executor: Optional[Executor] = None,
     ) -> None:
         def _consume_part() -> None:
-            logical = self._decode_and_verify(rec, buf)
-            with _cprof.substep(
-                self._profile, "reassemble", len(logical)
-            ):
-                with self._lock:
-                    if self._buf is None:
-                        self._buf = bytearray(self.nbytes)
+            from .chunkstore import decode_and_verify_chunk
+
+            with _cprof.consume_section():
+                self._ensure_buf()
+                n = int(rec["n"])
                 # Disjoint offsets: concurrent executor threads never
-                # overlap.
-                memoryview(self._buf)[
-                    offset : offset + len(logical)
-                ] = logical
+                # overlap. Identity-coded chunks decode ZERO-COPY
+                # straight into the pooled assembly buffer (one verify
+                # + one memcpy); codec chunks decode to a transient
+                # then splice.
+                out = memoryview(self._buf)[offset : offset + n]
+                logical = decode_and_verify_chunk(
+                    rec,
+                    self._dtype_name,
+                    buf,
+                    profile=self._profile,
+                    out=out,
+                )
+                if logical is not None:
+                    with _cprof.substep(
+                        self._profile, "reassemble", len(logical)
+                    ):
+                        out[: len(logical)] = logical
 
         if executor is not None:
             loop = asyncio.get_running_loop()
@@ -953,16 +1193,15 @@ class _ContentChunksReadState:
         if last:
             try:
                 await self._inner.consume_buffer(
-                    memoryview(self._buf), executor
+                    memoryview(self._buf)[: self.nbytes], executor
                 )
             finally:
-                with _cprof.substep(
+                with _cprof.consume_section(), _cprof.substep(
                     self._profile, "staging_release", self.nbytes
                 ):
-                    self._buf = None  # free eagerly
-                    release, self._cost_release = self._cost_release, None
-                    if release is not None:
-                        release(self.nbytes)
+                    # Pool return fires the scheduler budget re-credit
+                    # exactly once, however many chunks shared it.
+                    self._release_assembly_buffer()
 
 
 class _ContentChunkConsumer(BufferConsumer):
@@ -1082,7 +1321,14 @@ class ArrayRestorePlan:
                 off, sz = index_to_offsets_sizes(shard.index, shape)
                 key = (tuple(off), tuple(sz))
                 if key not in regions:
-                    regions[key] = _TargetRegion(off, sz, self._dtype)
+                    # Device-template region buffers are pool-backed:
+                    # device_put copies out of them, so the backing can
+                    # be donated back to the pool at finalize. Host
+                    # templates hand the buffer to the app — never
+                    # pooled.
+                    regions[key] = _TargetRegion(
+                        off, sz, self._dtype, poolable=True
+                    )
                 regions[key].devices.append(shard.device)
         else:
             if template is not None and hasattr(template, "shape"):
@@ -1094,21 +1340,81 @@ class ArrayRestorePlan:
             off = [0] * len(shape)
             regions[(tuple(off), tuple(shape))] = _TargetRegion(off, shape, self._dtype)
         self._regions = list(regions.values())
+        # Host-backed (CPU) devices can ALIAS a device_put numpy buffer
+        # instead of copying it — donating such a region's pooled
+        # backing would let a later restore overwrite the "restored"
+        # array through the alias. Pool region buffers only when every
+        # consumer device actually copies across a link.
+        for region in self._regions:
+            if any(
+                getattr(d, "platform", None) == "cpu"
+                for d in region.devices
+            ):
+                region._poolable = False
         self._chunks = chunks
         # Eager-finalize bookkeeping: the last chunk consumer to complete
-        # triggers finalize() from its executor thread, so host→device
-        # assembly of this array overlaps with other arrays' reads.
+        # triggers finalize() from its executor thread (or the overlap
+        # engine's done-callback thread), so host→device assembly of
+        # this array overlaps with other arrays' reads.
         self._outstanding = 0
         self._finalized = False
         self._lock = threading.Lock()
         self._profile = _cprof.current()
+        # Overlap-engine bookkeeping: every engine future (streamed
+        # chunks + early region puts) is registered here so finalize
+        # surfaces transfer failures before publishing, and the
+        # completion event closes the tiny future-resolved→callback-ran
+        # window for the safety-net finalizer.
+        self._transfers: List[Any] = []
+        self._complete = threading.Event()
+        self._finalize_done = threading.Event()
+        self._finalize_error: Optional[BaseException] = None
+
+    def _register_transfer(self, fut: Any) -> None:
+        with self._lock:
+            self._transfers.append(fut)
 
     def _on_req_done(self) -> None:
         with self._lock:
             self._outstanding -= 1
             if self._outstanding != 0:
                 return
+        self._complete.set()
         self.finalize()
+
+    def _note_region_copy(self, region: _TargetRegion) -> None:
+        """A chunk-copy consumer finished scattering into ``region``.
+        When the region's last copy lands — and it is a single-device,
+        engine-worthy region — dispatch its H2D on the overlap engine
+        NOW instead of at plan finalize, so transfers of completed
+        regions overlap chunks still reading/decoding."""
+        with self._lock:
+            region.pending_copies -= 1
+            ready = region.pending_copies == 0
+        if (
+            not ready
+            or not self._template_is_jax
+            or region.device_chunks is not None
+            or len(region.devices) != 1
+            or region.buffer is None
+            or region.nbytes < 2 * h2d_chunk_bytes()
+        ):
+            return
+        fut = h2d_pipeline().submit(
+            region.buffer, region.devices[0], profile=self._profile
+        )
+        region.early_put = fut
+        self._register_transfer(fut)
+        fut.add_done_callback(
+            lambda f, region=region: self._early_put_done(region, f)
+        )
+
+    def _early_put_done(self, region: _TargetRegion, fut: Any) -> None:
+        # The engine block_until_ready'd the transfer (or it failed):
+        # either way the pooled backing is no longer read — donate it
+        # back promptly so concurrent restores stop waiting on pool
+        # capacity. The device array lives in the future for finalize.
+        region.release_lease()
 
     def build_read_reqs(self) -> List[ReadReq]:
         reqs: List[ReadReq] = []
@@ -1183,12 +1489,15 @@ class ArrayRestorePlan:
             if ok:
                 stream_region[rid] = flat_bases
                 # The host-side region buffer is never touched on this
-                # path; drop it so a large restore does not hold an
-                # idle full-size host allocation.
-                region.buffer = None
+                # path (and, being lazy, was never allocated); the
+                # device-chunk dict marks the region as streaming.
                 region.device_chunks = {}
 
-        # Pass 3: emit read requests.
+        # Pass 3: emit read requests. Adopting a zero-copy view is only
+        # safe when the payload handed to the consumer is NOT a pooled
+        # assembly buffer (the view would pin pool memory past its
+        # release); direct read payloads always qualify.
+        adopt_from_state_ok = staging_pool.get_staging_pool() is None
         for (chunk_off, chunk_sz, location, chunk_checksum, compression,
              aentry, copies) in planned:
             chunk_nbytes = _chunk_nbytes(chunk_sz, itemsize)
@@ -1200,6 +1509,8 @@ class ArrayRestorePlan:
                 # overlaps the remaining reads — then scattered into
                 # the overlapping regions exactly like a whole-object
                 # read would be.
+                for region, _rs, _ov in copies:
+                    region.pending_copies += 1
                 inner = _ChunkCopyConsumer(
                     view_shape=list(chunk_sz),
                     dtype=self._dtype,
@@ -1208,6 +1519,8 @@ class ArrayRestorePlan:
                         for region, region_slices, ov in copies
                     ],
                     on_done=self._on_req_done,
+                    allow_adopt=adopt_from_state_ok,
+                    region_notify=self._note_region_copy,
                 )
                 n_logical += 1
                 state = _ContentChunksReadState(
@@ -1239,6 +1552,7 @@ class ArrayRestorePlan:
                     checksum=chunk_checksum,
                     on_done=self._on_req_done,
                     flat_base=stream_region[id(region0)][id(ov0)],
+                    register_transfer=self._register_transfer,
                 )
                 n_logical += 1
                 reqs.extend(stream.add_sub_reads(location, part))
@@ -1266,15 +1580,21 @@ class ArrayRestorePlan:
                 # process/device fetches only the bytes it needs).
                 for (region, region_slices, ov), rng in zip(copies, ranges):
                     full = tuple(slice(0, s) for s in ov.sizes)
+                    sub_nbytes = rng[1] - rng[0]
+                    split = sub_nbytes > split_threshold
+                    region.pending_copies += 1
                     consumer = _ChunkCopyConsumer(
                         view_shape=list(ov.sizes),
                         dtype=self._dtype,
                         copies=[(region, region_slices, full)],
                         on_done=self._on_req_done,
+                        # Split payloads arrive as pooled assembly
+                        # views; direct ranged payloads may adopt.
+                        allow_adopt=(not split) or adopt_from_state_ok,
+                        region_notify=self._note_region_copy,
                     )
                     n_logical += 1
-                    sub_nbytes = rng[1] - rng[0]
-                    if sub_nbytes > split_threshold:
+                    if split:
                         # A large contiguous sub-range is still one
                         # stream: split it the same way as whole objects
                         # (offsets shifted by the range start).
@@ -1299,7 +1619,9 @@ class ArrayRestorePlan:
                 # Non-contiguous overlap somewhere: read the chunk once and
                 # scatter into every overlapping region. Whole-object reads
                 # can verify the stored checksum (ranged reads cannot).
-                def _whole_consumer():
+                def _whole_consumer(allow_adopt: bool = True):
+                    for region, _rs, _ov in copies:
+                        region.pending_copies += 1
                     return _ChunkCopyConsumer(
                         view_shape=list(chunk_sz),
                         dtype=self._dtype,
@@ -1310,6 +1632,8 @@ class ArrayRestorePlan:
                         checksum=chunk_checksum,
                         compression=compression,
                         on_done=self._on_req_done,
+                        allow_adopt=allow_adopt,
+                        region_notify=self._note_region_copy,
                     )
 
                 n_logical += 1
@@ -1323,7 +1647,7 @@ class ArrayRestorePlan:
                     # decided per-REGION in pass 2; chunks landing here
                     # reassemble on host.)
                     state = _SplitObjectReadState(
-                        chunk_nbytes, _whole_consumer()
+                        chunk_nbytes, _whole_consumer(adopt_from_state_ok)
                     )
                     reqs.extend(state.add_sub_reads(location, part))
                 else:
@@ -1337,38 +1661,99 @@ class ArrayRestorePlan:
             # One finalize trigger per logical chunk (a split chunk's
             # inner consumer fires on_done once, not once per sub-read).
             self._outstanding = n_logical
+        if n_logical == 0:
+            self._complete.set()
         return reqs
 
     def finalize(self) -> None:
-        # Idempotent: normally triggered eagerly by the last chunk consumer;
-        # the finalizer returned by prepare_read is the safety net for plans
-        # with zero read requests.
+        # Normally triggered eagerly by the last chunk consumer (or the
+        # overlap engine's last done-callback); the finalizer returned
+        # by prepare_read is the safety net for plans with zero read
+        # requests — and, post-fastlane, the thread that surfaces a
+        # failed overlap-engine transfer. The latch is BLOCKING, not
+        # merely idempotent: an eager finalize may be mid-assembly on
+        # an engine thread the scheduler never awaited, so a losing
+        # caller must wait for publication (and re-raise the winner's
+        # failure) before the restore continues past its finalizers.
+        run = False
         with self._lock:
-            if self._finalized:
-                return
-            self._finalized = True
-        with tracing.span("assemble"):
-            self._finalize_impl()
+            if not self._finalized:
+                self._finalized = True
+                run = True
+        if not run:
+            self._finalize_done.wait()
+            err = self._finalize_error
+            if err is not None:
+                raise err
+            return
+        if _on_h2d_engine_thread():
+            # Never block an engine worker in _await_pipeline: it may
+            # be the only worker able to run the futures being awaited
+            # (deadlock at depth 1). Hop to the finalize pool; waiters
+            # block on _finalize_done as usual and re-raise any error.
+            _get_finalize_pool().submit(self._finalize_now)
+            return
+        self._finalize_now()
+
+    def _finalize_now(self) -> None:
+        try:
+            self._await_pipeline()
+            with tracing.span("assemble"):
+                self._finalize_impl()
+        except BaseException as e:  # noqa: BLE001 — SimulatedCrash must surface
+            # When this runs on the finalize pool the raise lands in an
+            # unobserved future; the error still reaches the restore
+            # thread via _finalize_error at the safety-net finalizer.
+            self._finalize_error = e
+            raise
+        finally:
+            self._finalize_done.set()
+
+    def _await_pipeline(self) -> None:
+        """Wait out (and surface errors from) every overlap-engine
+        transfer this plan dispatched, BEFORE anything is published. A
+        transfer failure (including faultline's SimulatedCrash) or an
+        incomplete pipeline raises here — the restore fails with the
+        template untouched, never with a torn leaf."""
+        with self._lock:
+            transfers = list(self._transfers)
+        for fut in transfers:
+            fut.result()  # re-raises transfer errors
+        with self._lock:
+            outstanding = self._outstanding
+        if outstanding == 0:
+            return
+        # All registered futures resolved; the only legitimate gap is a
+        # done-callback still running on another thread. Anything past
+        # a generous wait is a pipeline bug — refuse to assemble.
+        if not self._complete.wait(timeout=60.0):
+            raise RuntimeError(
+                f"streaming restore pipeline incomplete: "
+                f"{outstanding} chunk(s) never finished "
+                f"decode/verify/transfer — refusing to publish a torn "
+                f"leaf"
+            )
 
     def _finalize_impl(self) -> None:
         if self._template_is_jax:
-            # Streamed regions (device_chunks set) already noted their
-            # H2D bytes per chunk at absorb time — counting them again
-            # here would double the profile's device_put bytes; their
-            # finalize cost is only an on-device concat. Only regions
-            # placed from host buffers transfer bytes now.
+            # Streamed regions (device_chunks set) noted their H2D as
+            # per-chunk h2d_overlap on the engine, and early-dispatched
+            # regions (early_put set) likewise — counting them again
+            # here would double the profile's transfer bytes. Only
+            # regions still placed from host buffers at finalize
+            # transfer bytes now.
             with _cprof.substep(
                 self._profile,
                 "device_put",
                 sum(
                     r.nbytes * max(1, len(r.devices))
                     for r in self._regions
-                    if r.device_chunks is None
+                    if r.device_chunks is None and r.early_put is None
                 ),
             ):
                 self._finalize_jax()
             return
-        out = self._regions[0].buffer
+        out = self._regions[0].ensure_buffer(self._profile)
         if not out.flags.writeable:
             # Adopted zero-copy payload views are read-only; host
             # restores hand back writable arrays (apps mutate restored
@@ -1388,8 +1773,19 @@ class ArrayRestorePlan:
         buffers = []
         devices = []
         prebuilt: Dict[int, Any] = {}
+        lease_slots: List[Tuple[_TargetRegion, int]] = []
         for region in self._regions:
             for device in region.devices:
+                if region.early_put is not None:
+                    # The overlap engine already placed this region
+                    # (chunk-granular overlap: dispatched the moment its
+                    # last copy landed); the future is resolved — errors
+                    # were surfaced by _await_pipeline — and the pooled
+                    # backing was donated back in the done-callback.
+                    prebuilt[len(buffers)] = region.early_put.result()
+                    buffers.append(None)
+                    devices.append(device)
+                    continue
                 if region.device_chunks is not None:
                     # Streaming reads: the bytes are already on
                     # device as 1-D chunks keyed by flat offset —
@@ -1428,6 +1824,8 @@ class ArrayRestorePlan:
                         )
                         for cb, nbytes in releases:
                             cb(nbytes)
+                if region._lease is not None:
+                    lease_slots.append((region, len(buffers)))
                 buffers.append(region.buffer)
                 devices.append(device)
         chunk_mask = [
@@ -1464,6 +1862,29 @@ class ArrayRestorePlan:
         if self._prng_impl is not None:
             out = jax.random.wrap_key_data(out, impl=self._prng_impl)
         self._callback(out)
+        if lease_slots:
+            # Batched donation: pooled region buffers return to the
+            # pool in ONE pass — after the runtime finished reading
+            # them (device_put can return before the copy-out), so a
+            # reuse by a concurrent restore can never alias an
+            # in-flight transfer. Publication (the callback above) was
+            # not delayed by this wait.
+            with _cprof.substep(
+                self._profile,
+                "staging_release",
+                sum(r.nbytes for r, _ in lease_slots),
+            ):
+                try:
+                    jax.block_until_ready(
+                        [arrays[i] for _, i in lease_slots]
+                    )
+                except Exception:  # snapcheck: disable=swallowed-exception -- donation wait; a transfer failure keeps the lease unreleased (GC net)
+                    return
+                seen = set()
+                for region, _ in lease_slots:
+                    if id(region) not in seen:
+                        seen.add(id(region))
+                        region.release_lease()
 
 
 def _chunk_nbytes(sizes: List[int], itemsize: int) -> int:

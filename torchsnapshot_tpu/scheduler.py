@@ -17,9 +17,16 @@ un-reaped, scheduler.py:133-135 — both fixed here):
   ``staging_cost − len(buf)``; on write completion re-credit ``len(buf)``.
 - read: charge ``consuming_cost`` at dispatch; re-credit it after consume —
   except a consumer's *deferred* portion (a split read's shared assembly
-  buffer, which outlives the individual sub-read consumes), which the
-  consumer re-credits through a releaser callback when the allocation is
-  actually freed.
+  buffer, which outlives the individual sub-read consumes; a streamed
+  part's payload, which the H2D overlap engine holds until its transfer
+  lands), which the consumer re-credits through a releaser callback when
+  the allocation is actually freed. Pooled staging buffers
+  (``staging_pool.py``) bind that releaser to their lease, which fires
+  it exactly ONCE when the buffer returns to the pool — the pre-fastlane
+  path assumed single-use allocations, and a pooled buffer re-crediting
+  per sub-read would multiply-credit the budget. Releases may arrive
+  from engine threads after this loop exited; ``_BudgetCell`` is locked
+  for exactly that.
 
 At least one request is always in flight regardless of budget so a single
 over-budget buffer cannot deadlock the pipeline (reference

@@ -977,6 +977,7 @@ class Snapshot:
             if profile_block is not None:
                 consumed_bytes = int(consume_agg.get("bytes", 0))
                 profile_block["bytes"] = consumed_bytes
+                probe = None
                 if consume_s > 0 and consumed_bytes > 0:
                     gbps = consumed_bytes / (1 << 30) / consume_s
                     profile_block["consume_gbps"] = round(gbps, 6)
@@ -985,6 +986,25 @@ class Snapshot:
                         profile_block["h2d_probe_gbps"] = round(probe, 4)
                         profile_block["h2d_fraction"] = round(
                             gbps / probe, 6
+                        )
+                # Streaming fast path: the overlap engine's delivered
+                # H2D throughput — transfers ran OFF the consume wall,
+                # so consume_gbps no longer bounds the restore; this
+                # number (vs the probe) is what certifies the pipeline
+                # kept the link busy (bench's restore_vs_h2d_ceiling).
+                overlap = (profile_block.get("substeps") or {}).get(
+                    "h2d_overlap"
+                )
+                if overlap and overlap.get("seconds", 0) > 0:
+                    ogbps = (
+                        overlap.get("bytes", 0)
+                        / (1 << 30)
+                        / overlap["seconds"]
+                    )
+                    profile_block["h2d_overlap_gbps"] = round(ogbps, 6)
+                    if probe:
+                        profile_block["h2d_overlap_vs_probe"] = round(
+                            ogbps / probe, 6
                         )
                 recorder.note(consume_profile=profile_block)
         except Exception as e:

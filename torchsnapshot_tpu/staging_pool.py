@@ -1,0 +1,269 @@
+"""Pooled host staging buffers for the streaming restore pipeline.
+
+The pre-fastlane restore allocated a fresh host buffer for every
+assembly unit — one ``bytearray(nbytes)`` per split whole-object read
+(``_SplitObjectReadState``), per content-chunked object
+(``_ContentChunksReadState``), and one ``np.empty`` per target region
+(``_TargetRegion``) — and dropped it on the floor after one use. At
+restore scale that is GiBs of allocate/fault/free churn sitting inside
+the consume executors, and every release re-credited the scheduler's
+host budget through a callback path that assumed single-use
+allocations.
+
+This module replaces those with a process-wide pool of reusable,
+exact-size buffers keyed by the restore plan's region/object sizes
+(restore plans repeat sizes heavily — all of a model's layers share a
+handful of shapes — so exact-size reuse hits). Concurrent restores
+share the one pool; attribution stays per-restore because the
+``pool_wait`` sub-step is noted into the caller's captured
+:class:`~torchsnapshot_tpu.telemetry.consume_profile.ConsumeProfile`.
+
+Budget contract (the fastlane accounting fix): a lease carries at most
+ONE scheduler budget re-credit, attached via
+:meth:`StagingLease.set_budget_release` and fired exactly once when the
+buffer actually returns to the pool — never per sub-read, never twice,
+whatever mix of executor threads, H2D-engine callbacks, and error paths
+races to release it.
+
+Env knobs:
+
+- ``TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES`` — pool capacity (default
+  1 GiB). Bounds both the retained free set and the point past which
+  new acquisitions wait for a release. ``0`` disables pooling entirely
+  (callers fall back to plain allocations).
+- ``TPUSNAPSHOT_RESTORE_POOL_WAIT_S`` — max seconds an acquisition
+  waits at capacity before allocating past the cap anyway (default 5).
+  The cap is a pressure valve, not a correctness limit: the scheduler's
+  host-memory budget is the real bound, so the pool must never deadlock
+  a pipeline the budget already admitted.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import telemetry
+from .telemetry import consume_profile as _cprof
+from .telemetry import metrics as _metric_names
+from .utils.env import env_float, env_int
+
+_POOL_BYTES_ENV_VAR = "TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES"
+_DEFAULT_POOL_BYTES = 1 << 30
+_POOL_WAIT_ENV_VAR = "TPUSNAPSHOT_RESTORE_POOL_WAIT_S"
+_DEFAULT_POOL_WAIT_S = 5.0
+
+
+def pool_capacity_bytes() -> int:
+    return env_int(_POOL_BYTES_ENV_VAR, _DEFAULT_POOL_BYTES)
+
+
+class StagingLease:
+    """One pooled buffer, owned by exactly one consumer state at a time.
+
+    ``release()`` is idempotent: the first call returns the buffer to
+    the pool and fires the attached scheduler-budget re-credit (if any)
+    exactly once; later calls are no-ops. Error paths can therefore
+    release defensively without double-crediting the budget.
+    """
+
+    __slots__ = ("buffer", "nbytes", "_pool", "_released", "_budget_cb",
+                 "_budget_nbytes", "_lock")
+
+    def __init__(self, pool: "StagingPool", buffer: bytearray, nbytes: int):
+        self.buffer = buffer
+        self.nbytes = nbytes
+        self._pool = pool
+        self._released = False
+        self._budget_cb: Optional[Callable[[int], None]] = None
+        self._budget_nbytes = 0
+        self._lock = threading.Lock()
+
+    def set_budget_release(
+        self, cb: Callable[[int], None], nbytes: int
+    ) -> None:
+        """Attach the scheduler's budget re-credit for this buffer's
+        reservation. Fired once, at actual release — the pooled analog
+        of the single-use releaser callback, minus the assumption that
+        every allocation dies with its consume."""
+        fire = False
+        with self._lock:
+            if self._released:
+                fire = True  # raced a release: credit now, once
+            else:
+                self._budget_cb = cb
+                self._budget_nbytes = nbytes
+        if fire:
+            cb(nbytes)
+
+    def as_array(self, dtype: np.dtype, shape: List[int]) -> np.ndarray:
+        count = 1
+        for s in shape:
+            count *= s
+        return np.frombuffer(
+            self.buffer, dtype=dtype, count=count
+        ).reshape(shape)
+
+    def release(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+            cb, self._budget_cb = self._budget_cb, None
+            nbytes = self._budget_nbytes
+        if cb is not None:
+            cb(nbytes)
+        self._pool._give_back(self.buffer, self.nbytes)
+
+    def __del__(self) -> None:
+        # Safety net for error paths (a failed restore dropping its
+        # plan mid-flight): an unreachable lease can have no live views
+        # into its buffer from the pipeline that owned it, so returning
+        # it keeps the pool's in-use accounting honest across repeated
+        # failure injections (faultline crash matrices).
+        try:
+            self.release()
+        except Exception:  # snapcheck: disable=swallowed-exception -- GC-time best effort
+            pass
+
+
+class StagingPool:
+    """Exact-size-bucketed free lists with a byte cap and bounded waits."""
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        max_wait_s: Optional[float] = None,
+    ) -> None:
+        self.capacity_bytes = capacity_bytes
+        self.max_wait_s = (
+            max_wait_s
+            if max_wait_s is not None
+            else env_float(_POOL_WAIT_ENV_VAR, _DEFAULT_POOL_WAIT_S)
+        )
+        self._cond = threading.Condition()
+        self._free: Dict[int, List[bytearray]] = {}
+        self._free_bytes = 0
+        self._in_use_bytes = 0
+
+    # ------------------------------------------------------------ acquire
+    def acquire(
+        self, nbytes: int, profile: Optional["_cprof.ConsumeProfile"] = None
+    ) -> StagingLease:
+        """A buffer of exactly ``nbytes``, reused when the pool holds
+        one. At capacity (outstanding + request past the cap while
+        other leases are live) the call waits — bounded by
+        ``max_wait_s`` — for a release, noting the wait into
+        ``profile`` as the ``pool_wait`` sub-step; it then allocates
+        past the cap rather than ever deadlocking the pipeline."""
+        with self._cond:
+            buf = self._take_free_locked(nbytes)
+            if buf is None:
+                # No exact-size hit: retained free buffers of OTHER
+                # sizes are just idle bytearrays — evict them to make
+                # capacity room rather than stalling behind them (a
+                # cap full of model A's region sizes must not make
+                # model B's restore wait out max_wait_s per buffer).
+                self._evict_free_locked(nbytes)
+            if buf is None and self._must_wait_locked(nbytes):
+                with _cprof.substep(profile, "pool_wait", nbytes):
+                    deadline = time.monotonic() + self.max_wait_s
+                    while buf is None and self._must_wait_locked(nbytes):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                        buf = self._take_free_locked(nbytes)
+                telemetry.counter(_metric_names.RESTORE_POOL_WAITS).inc(1)
+                if buf is None:
+                    buf = self._take_free_locked(nbytes)
+            if buf is None:
+                buf = bytearray(nbytes)
+                telemetry.counter(_metric_names.RESTORE_POOL_MISSES).inc(1)
+            else:
+                telemetry.counter(_metric_names.RESTORE_POOL_HITS).inc(1)
+            self._in_use_bytes += nbytes
+        return StagingLease(self, buf, nbytes)
+
+    def _take_free_locked(self, nbytes: int) -> Optional[bytearray]:
+        bucket = self._free.get(nbytes)
+        if not bucket:
+            return None
+        buf = bucket.pop()
+        if not bucket:
+            del self._free[nbytes]
+        self._free_bytes -= nbytes
+        return buf
+
+    def _evict_free_locked(self, need_bytes: int) -> None:
+        """Drop retained free buffers until ``need_bytes`` fits inside
+        the cap alongside the current outstanding bytes (or the free
+        set is empty). Eviction is cheap — the buffers are plain
+        bytearrays nobody references. When live leases alone already
+        exceed the cap, eviction cannot help: keep the cache (those
+        buffers are exactly what the in-flight restores will re-acquire
+        next) and let the caller's bounded wait handle it."""
+        if self._in_use_bytes + need_bytes > self.capacity_bytes:
+            return
+        while (
+            self._free_bytes > 0
+            and self._in_use_bytes + self._free_bytes + need_bytes
+            > self.capacity_bytes
+        ):
+            size = next(iter(self._free))
+            bucket = self._free[size]
+            bucket.pop()
+            if not bucket:
+                del self._free[size]
+            self._free_bytes -= size
+
+    def _must_wait_locked(self, nbytes: int) -> bool:
+        # Free bytes are evictable (see acquire) — only bytes held by
+        # LIVE leases can force a wait for a release.
+        return (
+            self._in_use_bytes > 0
+            and self._in_use_bytes + nbytes > self.capacity_bytes
+        )
+
+    # ------------------------------------------------------------ release
+    def _give_back(self, buffer: bytearray, nbytes: int) -> None:
+        with self._cond:
+            self._in_use_bytes -= nbytes
+            if self._free_bytes + nbytes <= self.capacity_bytes:
+                self._free.setdefault(nbytes, []).append(buffer)
+                self._free_bytes += nbytes
+            telemetry.gauge(_metric_names.RESTORE_POOL_RETAINED).set(
+                float(self._free_bytes)
+            )
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return {
+                "free_bytes": self._free_bytes,
+                "in_use_bytes": self._in_use_bytes,
+                "capacity_bytes": self.capacity_bytes,
+            }
+
+
+_pool_lock = threading.Lock()
+_pool: List[Optional[StagingPool]] = []
+
+
+def get_staging_pool() -> Optional[StagingPool]:
+    """The process-wide pool, or None when pooling is disabled
+    (``TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES=0``). The capacity env is
+    read once per process; tests use :func:`reset_staging_pool`."""
+    with _pool_lock:
+        if not _pool:
+            cap = pool_capacity_bytes()
+            _pool.append(StagingPool(cap) if cap > 0 else None)
+        return _pool[0]
+
+
+def reset_staging_pool() -> None:
+    """Drop the memoized pool (tests re-read the env knobs)."""
+    with _pool_lock:
+        _pool.clear()

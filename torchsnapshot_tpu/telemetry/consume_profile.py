@@ -23,10 +23,22 @@ verify              integrity: checksum verification, streaming crc
 reassemble          host memcpy: scattering chunk views into region
                     buffers, splicing ranged sub-reads into assembly
                     buffers
-device_put          H2D transfers: streamed chunk puts and the
-                    finalize-time batched/chunked device placement
+device_put          H2D transfers issued from INSIDE consume executors
+                    (small-region batched puts at a consume-triggered
+                    finalize)
 staging_release     freeing assembly/staging buffers and re-crediting
                     scheduler budget reservations
+pool_wait           waiting for a staging-pool buffer at pool capacity
+                    (staging_pool.py — budget pressure made visible)
+h2d_overlap         the overlap engine's H2D transfer wall
+                    (ops/transfer.py H2DPipeline) — UNION time across
+                    concurrent workers so bytes/seconds is delivered
+                    link GB/s; concurrent with reads/consumes, NOT
+                    part of consume wall
+overlap_other       in-consume-named work that ran outside any consume
+                    executor (engine-triggered finalize placement,
+                    donation waits) — beside the wall, kept separate
+                    so h2d_overlap's GB/s certificate stays pure
 other               consume wall the sub-steps above did not account
                     for (event-loop/executor scheduling, GIL waits) —
                     computed at collect time so the breakdown SUMS to
@@ -52,8 +64,13 @@ from typing import Any, Dict, Optional, Tuple
 from .. import tracing
 
 # Sub-steps that run INSIDE consume_buffer (their seconds reconcile
-# against the scheduler's consume op seconds); read_wait happens between
-# read completion and consume dispatch and is reported beside them.
+# against the scheduler's consume op seconds); the OVERLAP sub-steps
+# happen outside the consume wall and are reported beside them:
+# read_wait between read completion and consume dispatch, h2d_overlap
+# on the H2D overlap engine's transfer threads (ops/transfer.py
+# H2DPipeline) — device placement and buffer donation the streaming
+# fast path moved OFF the consume executors so it rides concurrently
+# with reads and decodes still in flight.
 IN_CONSUME_SUBSTEPS = (
     "deserialize",
     "decode",
@@ -61,14 +78,22 @@ IN_CONSUME_SUBSTEPS = (
     "reassemble",
     "device_put",
     "staging_release",
+    "pool_wait",
 )
-SUBSTEPS = ("read_wait",) + IN_CONSUME_SUBSTEPS
+# Beside-the-wall buckets: read_wait (scheduler queueing), h2d_overlap
+# (the overlap engine's transfers — union time, see overlap_span),
+# overlap_other (in-consume-named work that ran OUTSIDE a consume
+# section, e.g. an engine-triggered finalize's device placement and
+# buffer donation — kept separate from h2d_overlap so the engine's
+# delivered-GB/s certificate is never polluted by finalize bytes).
+OVERLAP_SUBSTEPS = ("read_wait", "h2d_overlap", "overlap_other")
+SUBSTEPS = OVERLAP_SUBSTEPS + IN_CONSUME_SUBSTEPS
 
 
 class ConsumeProfile:
     """Thread-safe sub-step accumulator for ONE restore."""
 
-    __slots__ = ("_lock", "_agg", "trace_id")
+    __slots__ = ("_lock", "_agg", "trace_id", "_ov_active", "_ov_start")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -77,6 +102,13 @@ class ConsumeProfile:
         # Captured at begin() so executor-thread sub-step spans can
         # stamp the restore's trace id without a contextvar handoff.
         self.trace_id = tracing.current_trace_id()
+        # Union-time clock for the overlap engine: h2d_overlap seconds
+        # count wall during which >= 1 transfer was in flight for THIS
+        # restore — summing per-call walls across depth-N concurrent
+        # workers would overstate seconds by up to the depth factor and
+        # understate the delivered GB/s the certificate is built from.
+        self._ov_active = 0
+        self._ov_start = 0.0
 
     def note(self, substep: str, seconds: float, nbytes: int = 0) -> None:
         with self._lock:
@@ -86,6 +118,23 @@ class ConsumeProfile:
             entry[0] += 1
             entry[1] += seconds
             entry[2] += nbytes
+
+    def _overlap_enter(self) -> None:
+        with self._lock:
+            if self._ov_active == 0:
+                self._ov_start = time.monotonic()
+            self._ov_active += 1
+
+    def _overlap_exit(self, nbytes: int) -> None:
+        with self._lock:
+            self._ov_active -= 1
+            entry = self._agg.get("h2d_overlap")
+            if entry is None:
+                entry = self._agg["h2d_overlap"] = [0, 0.0, 0]
+            entry[0] += 1
+            entry[2] += nbytes
+            if self._ov_active == 0:
+                entry[1] += time.monotonic() - self._ov_start
 
     def summary(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -102,6 +151,37 @@ class ConsumeProfile:
 _SCOPE: "contextvars.ContextVar[Optional[ConsumeProfile]]" = (
     contextvars.ContextVar("tpusnapshot_consume_profile", default=None)
 )
+
+# Consume-section marker (thread-local): consumer executor bodies wrap
+# their work in consume_section() so sub-step notes can tell "inside a
+# scheduler consume span" from "on the overlap side". The same code
+# (e.g. ArrayRestorePlan.finalize) runs on either side depending on
+# which completion fired last; an in-consume-named note recorded
+# OUTSIDE a consume section is pipeline work that overlapped the
+# consume wall, so it folds into ``overlap_other`` (NOT h2d_overlap —
+# that bucket is reserved for the engine's own transfer clock) —
+# keeping the in-consume sub-steps summing exactly to the consume wall.
+_SECTION = threading.local()
+
+
+@contextmanager
+def consume_section():
+    prev = getattr(_SECTION, "active", False)
+    _SECTION.active = True
+    try:
+        yield
+    finally:
+        _SECTION.active = prev
+
+
+def in_consume_section() -> bool:
+    return getattr(_SECTION, "active", False)
+
+
+def _route(name: str) -> str:
+    if name in IN_CONSUME_SUBSTEPS and not in_consume_section():
+        return "overlap_other"
+    return name
 
 
 def begin() -> Tuple[ConsumeProfile, Any]:
@@ -150,6 +230,35 @@ def collect(
 
 
 @contextmanager
+def overlap_span(profile: Optional[ConsumeProfile], nbytes: int = 0):
+    """Time one overlap-engine transfer into ``h2d_overlap`` with
+    UNION-time semantics: concurrent transfers for one restore advance
+    the clock once, so bytes/seconds is the engine's delivered link
+    throughput at any depth. Emits a ``consume.h2d_overlap`` span per
+    transfer while tracing is on (spans may overlap — that is the
+    point)."""
+    if profile is None:
+        yield
+        return
+    if tracing.enabled():
+        span_args: Dict[str, Any] = {"bytes": nbytes}
+        if profile.trace_id is not None:
+            span_args["trace"] = profile.trace_id
+        with tracing.span("consume.h2d_overlap", **span_args):
+            profile._overlap_enter()
+            try:
+                yield
+            finally:
+                profile._overlap_exit(nbytes)
+        return
+    profile._overlap_enter()
+    try:
+        yield
+    finally:
+        profile._overlap_exit(nbytes)
+
+
+@contextmanager
 def substep(
     profile: Optional[ConsumeProfile], name: str, nbytes: int = 0
 ):
@@ -163,6 +272,7 @@ def substep(
     if profile is None:
         yield
         return
+    name = _route(name)
     if tracing.enabled():
         span_args: Dict[str, Any] = {"bytes": nbytes}
         if profile.trace_id is not None:

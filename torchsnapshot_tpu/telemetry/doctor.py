@@ -164,12 +164,20 @@ _CONSUME_SUBSTEP_REMEDIATION = {
         "entirely."
     ),
     "device_put": (
-        "H2D transfer dominates: the restore is at (or near) the "
-        "hardware bound — compare consume GB/s against h2d_probe_gbps "
-        "in this report. If the fraction is low, transfers are not "
-        "overlapping reads: raise the device restore budget "
-        "(TPUSNAPSHOT_DEVICE_BUDGET_BYTES) so more regions stream "
-        "concurrently."
+        "H2D transfers are running INSIDE consume executors instead of "
+        "on the overlap engine — the streaming fast path is not "
+        "engaging (regions too small, compressed payloads, or a "
+        "resharded template). Check restore_consume_vs_h2d in the "
+        "bench artifact / h2d_overlap_vs_probe in this report, raise "
+        "the H2D depth (TPUSNAPSHOT_H2D_DEPTH) and the device restore "
+        "budget (TPUSNAPSHOT_DEVICE_BUDGET_BYTES) so more regions "
+        "stream concurrently."
+    ),
+    "pool_wait": (
+        "consumes are blocking on staging-pool capacity: concurrent "
+        "restores (or very large plans) exhausted the pooled staging "
+        "bytes. Raise TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES (0 "
+        "disables pooling outright) or lower restore concurrency."
     ),
     "staging_release": (
         "buffer release/accounting dominates — pathological; likely "
@@ -213,12 +221,16 @@ def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
     )
     remediation = (
         "storage is innocent — the bottleneck is host-side "
-        "deserialization / host->device placement. Check "
-        "compression settings (zlib inflate is single-threaded "
-        "per buffer), raise the device restore budget "
-        "(TPUSNAPSHOT_DEVICE_BUDGET_BYTES), and confirm "
-        "consumes overlap reads in the trace (summarize's overlap "
-        "column)."
+        "deserialization / host->device placement. The streaming "
+        "fast path should keep consume off the critical path: check "
+        "compression settings (zlib inflate is single-threaded per "
+        "buffer), confirm the overlap engine is engaging "
+        "(h2d_overlap in the sub-step breakdown; tune "
+        "TPUSNAPSHOT_H2D_DEPTH), give concurrent restores pool "
+        "headroom (TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES), raise "
+        "the device restore budget "
+        "(TPUSNAPSHOT_DEVICE_BUDGET_BYTES), and confirm consumes "
+        "overlap reads in the trace (summarize's overlap column)."
     )
     # Micro-profiler upgrade (snapxray): when rank summaries carry the
     # consume sub-phase breakdown, the finding names the dominant
@@ -226,9 +238,18 @@ def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
     profiles = _consume_profiles(report)
     if profiles:
         substeps: Dict[str, float] = {}
+        overlap_s = 0.0
         for p in profiles:
             for name, entry in (p.get("substeps") or {}).items():
-                if name == "read_wait":
+                # Beside-the-wall sub-steps: read_wait (scheduler
+                # queueing), h2d_overlap (the streaming pipeline's
+                # engine transfers), and overlap_other (engine-side
+                # finalize work) overlap the consume wall — they must
+                # not be named "the dominant consume sub-step".
+                if name in ("read_wait", "overlap_other"):
+                    continue
+                if name == "h2d_overlap":
+                    overlap_s += float(entry.get("seconds") or 0.0)
                     continue
                 substeps[name] = substeps.get(name, 0.0) + float(
                     entry.get("seconds") or 0.0
@@ -248,6 +269,25 @@ def _rule_consume_dominated(report: Dict[str, Any]) -> Optional[Finding]:
             if fractions:
                 evidence["consume_h2d_fraction"] = round(
                     min(fractions), 4
+                )
+            # Streaming-pipeline evidence: how hard the overlap engine
+            # ran, and its delivered H2D vs the probe — named
+            # restore_vs_h2d_ceiling to MATCH the bench key gating the
+            # same quantity (consume_h2d_fraction above is the bench's
+            # restore_consume_vs_h2d analog). A firing rule WITH
+            # healthy overlap numbers points at host-side work
+            # (decode/deserialize); without them the fast path never
+            # engaged.
+            if overlap_s:
+                evidence["h2d_overlap_s"] = round(overlap_s, 3)
+            overlap_fractions = [
+                p.get("h2d_overlap_vs_probe")
+                for p in profiles
+                if p.get("h2d_overlap_vs_probe") is not None
+            ]
+            if overlap_fractions:
+                evidence["restore_vs_h2d_ceiling"] = round(
+                    min(overlap_fractions), 4
                 )
             title += (
                 f"; dominant sub-step: {dominant} "

@@ -435,3 +435,23 @@ CHUNKSTORE_GC = (
 )
 CODEC_BYTES = "tpusnapshot_codec_bytes_total"  # counter {dir,codec}
 CODEC_SECONDS = "tpusnapshot_codec_seconds_total"  # counter {op}
+# Streaming restore fast path (fastlane): the staging-buffer pool's
+# hit/miss/wait counters plus its retained-free gauge, and the H2D
+# overlap engine's transfer accounting — the seconds/bytes the restore
+# moved OFF the consume executors onto the overlap engine.
+RESTORE_POOL_HITS = (
+    "tpusnapshot_restore_staging_pool_hits_total"  # counter
+)
+RESTORE_POOL_MISSES = (
+    "tpusnapshot_restore_staging_pool_misses_total"  # counter
+)
+RESTORE_POOL_WAITS = (
+    "tpusnapshot_restore_staging_pool_waits_total"  # counter
+)
+RESTORE_POOL_RETAINED = (
+    "tpusnapshot_restore_staging_pool_retained_bytes"  # gauge
+)
+H2D_OVERLAP_SECONDS = (
+    "tpusnapshot_h2d_overlap_seconds_total"  # counter
+)
+H2D_OVERLAP_BYTES = "tpusnapshot_h2d_overlap_bytes_total"  # counter

@@ -151,25 +151,44 @@ def summarize(spans: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
 
     # Consume-breakdown fold (snapxray): consume.<substep> spans from
     # the micro-profiler, as shares of the consume phase's busy time.
+    # Beside-the-wall sub-steps (read_wait; the fastlane overlap
+    # engine's h2d_overlap/overlap_other) fold into the table for
+    # visibility but carry NO consume share and are never named
+    # dominant — engine transfers on a wire-bound restore would
+    # otherwise always "dominate" a wall they are not part of (the same
+    # exclusion doctor and bench_compare apply).
+    _BESIDE_WALL = ("read_wait", "h2d_overlap", "overlap_other")
     consume_busy = (phases.get("consume") or {}).get("busy_s", 0.0)
     breakdown: Dict[str, Dict[str, Any]] = {}
     for name, p in phases.items():
         if not name.startswith("consume.") or p.get("instant"):
             continue
         sub = name[len("consume."):]
+        beside = sub in _BESIDE_WALL
         breakdown[sub] = {
             "busy_s": p["busy_s"],
             "total_s": p["total_s"],
             "bytes": p["bytes"],
             "share": (
                 round(min(1.0, p["busy_s"] / consume_busy), 4)
-                if consume_busy
+                if consume_busy and not beside
                 else None
             ),
         }
+        if beside:
+            breakdown[sub]["beside_wall"] = True
     consume_breakdown: Optional[Dict[str, Any]] = None
     if breakdown:
-        dominant = max(breakdown, key=lambda s: breakdown[s]["busy_s"])
+        in_wall = {
+            s: v
+            for s, v in breakdown.items()
+            if not v.get("beside_wall")
+        }
+        dominant = (
+            max(in_wall, key=lambda s: in_wall[s]["busy_s"])
+            if in_wall
+            else None
+        )
         consume_breakdown = {
             "substeps": breakdown,
             "dominant_substep": dominant,
@@ -266,20 +285,26 @@ def render(summary: Dict[str, Any]) -> str:
     breakdown = summary.get("consume_breakdown")
     if breakdown:
         lines.append("")
+        dominant = breakdown["dominant_substep"]
         lines.append(
-            f"consume breakdown (dominant sub-step: "
-            f"{breakdown['dominant_substep']}):"
+            "consume breakdown"
+            + (
+                f" (dominant sub-step: {dominant}):"
+                if dominant
+                else " (all sub-steps beside the consume wall):"
+            )
         )
         for sub, p in sorted(
             breakdown["substeps"].items(),
             key=lambda kv: -kv[1]["busy_s"],
         ):
             share = p.get("share")
-            share_str = (
-                f"{100 * share:5.1f}% of consume"
-                if share is not None
-                else " " * 18
-            )
+            if p.get("beside_wall"):
+                share_str = "beside consume wall"
+            elif share is not None:
+                share_str = f"{100 * share:5.1f}% of consume"
+            else:
+                share_str = " " * 18
             lines.append(
                 f"  consume.{sub:18s} {p['busy_s']:9.3f}s busy  "
                 f"{share_str}  {p['bytes'] / 1024**3:8.2f} GB"

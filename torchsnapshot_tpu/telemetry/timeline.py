@@ -131,6 +131,17 @@ _BENCH_METRICS: List[_MetricDef] = [
         0.02,
         0.3,
     ),
+    # fastlane: the streaming restore pipeline's overlap-engine H2D
+    # GB/s over the bracketed ceiling — ~1.0 means the restore is
+    # wire-bound; a drop is the pipeline sliding back toward a
+    # consume-serialized restore.
+    (
+        "restore_vs_h2d_ceiling",
+        "bench restore-H2D/ceiling",
+        "low",
+        0.05,
+        0.2,
+    ),
 ]
 
 
