@@ -1,0 +1,31 @@
+"""SNAP008 positive fixtures: contextvar reads across thread hops."""
+import contextvars
+import threading
+
+from torchsnapshot_tpu import tracing
+
+_ACCUMULATOR = contextvars.ContextVar("fixture_accumulator", default=None)
+
+
+def submit_callback_reads_trace(executor):
+    def on_done():
+        return tracing.current_trace_id()
+
+    executor.submit(on_done)
+
+
+def drain_thread_emits_span(payloads):
+    def loop():
+        with tracing.span("drain", n=len(payloads)):
+            return list(payloads)
+
+    threading.Thread(target=loop).start()
+
+
+def callback_reads_accumulator(executor):
+    def fold(result):
+        scope = _ACCUMULATOR.get()
+        if scope is not None:
+            scope.append(result)
+
+    executor.submit(fold, 1)

@@ -1,0 +1,33 @@
+"""SNAP007 positive fixtures: blocking work on the event loop."""
+import subprocess
+import time
+
+
+class ReadHandler:
+    def _read_sync(self, req):
+        return open(req).read()
+
+    async def handle_read(self, req):
+        # Sync storage helper called directly on the loop: every
+        # in-flight request stalls behind this read.
+        return self._read_sync(req)
+
+    async def handle_lock(self, req):
+        self._cache_lock.acquire()
+        try:
+            return self._cache[req]
+        finally:
+            self._cache_lock.release()
+
+    async def handle_probe(self, cmd):
+        return subprocess.check_output(cmd)
+
+
+def _backoff_helper(seconds):
+    time.sleep(seconds)
+
+
+async def drain_step(item):
+    # Transitively blocking: the helper runs on the loop.
+    _backoff_helper(0.5)
+    return item

@@ -1,0 +1,39 @@
+"""SNAP006 positive fixtures: dropped/double/discarded obligations."""
+from torchsnapshot_tpu import tracing
+
+
+def leaked_lease_on_exception_edge(pool, nbytes, consume):
+    lease = pool.acquire(nbytes)
+    consume(lease.buffer)  # may raise -> release never runs
+    lease.release()
+
+
+def double_release(pool, nbytes, consume, degraded):
+    lease = pool.acquire(nbytes)
+    try:
+        if degraded:
+            lease.release()
+    finally:
+        lease.release()
+
+
+def discarded_acquire(pool, nbytes):
+    pool.acquire(nbytes)
+
+
+def write_through_dropped_on_failure(rt, root, path, write_durable):
+    rt.begin_write_through(root, path)
+    write_durable(path)  # raising skips BOTH note and abort
+    rt.note_write_through(root, path)
+
+
+def bare_span_never_enters(path):
+    tracing.span("write", path=path)
+
+
+def release_skipped_on_early_return(pool, nbytes, cond):
+    lease = pool.acquire(nbytes)
+    if cond:
+        return None
+    lease.release()
+    return True

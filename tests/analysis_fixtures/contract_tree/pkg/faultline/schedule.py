@@ -1,0 +1,15 @@
+"""SNAP009 positive: a FaultRule kind missing from docs/FAULTS.md."""
+
+
+class FaultRule:
+    def __init__(self, kind, op):
+        self.kind = kind
+        self.op = op
+
+
+def documented_rule(op):
+    return FaultRule(kind="fixture_documented", op=op)
+
+
+def undocumented_rule(op):
+    return FaultRule(kind="fixture_undocumented", op=op)

@@ -1,0 +1,16 @@
+"""SNAP009 positive: a doctor rule id missing from the doc table."""
+
+
+class Finding:
+    def __init__(self, rule, severity, title):
+        self.rule = rule
+        self.severity = severity
+        self.title = title
+
+
+def rule_documented(report):
+    return Finding("fixture-documented-rule", "warn", "ok")
+
+
+def rule_undocumented(report):
+    return Finding("fixture-undocumented-rule", "warn", "missing")

@@ -1,0 +1,8 @@
+"""SNAP009 positive: a ledger digest field missing from the schema doc."""
+
+
+def digest_from_report(report):
+    return {
+        "fixture_documented_field": report.get("wall_s"),
+        "fixture_undocumented_field": report.get("gbps"),
+    }

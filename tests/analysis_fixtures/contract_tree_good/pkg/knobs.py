@@ -1,0 +1,12 @@
+"""SNAP009 positive: env knobs the sibling docs/api.md does not list."""
+import os
+
+_INTERVAL_ENV_VAR = "TPUSNAPSHOT_FIXTURE_INTERVAL_S"
+
+
+def documented_knob():
+    return os.environ.get("TPUSNAPSHOT_FIXTURE_DOCUMENTED", "1")
+
+
+def undocumented_knob():
+    return os.environ.get("TPUSNAPSHOT_FIXTURE_KNOB", "0")

@@ -1,0 +1,4 @@
+"""SNAP009 positive: a metric name missing from docs/OBSERVABILITY.md."""
+
+FIXTURE_DOCUMENTED = "tpusnapshot_fixture_documented_total"  # counter
+FIXTURE_UNDOCUMENTED = "tpusnapshot_fixture_undocumented_total"  # counter

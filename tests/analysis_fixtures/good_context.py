@@ -1,0 +1,42 @@
+"""SNAP008 negative fixtures: context captured or adopted across hops."""
+import contextvars
+import threading
+
+from torchsnapshot_tpu import tracing
+
+_ACCUMULATOR = contextvars.ContextVar("fixture_accumulator", default=None)
+
+
+def value_captured_outside(executor):
+    # The safe idiom: read in the submitting thread, close over the value.
+    tid = tracing.current_trace_id()
+
+    def on_done():
+        with tracing.adopt_trace(tid):
+            return tid
+
+    executor.submit(on_done)
+
+
+def drain_thread_adopts(payloads, trace_id):
+    def loop():
+        with tracing.adopt_trace(trace_id):
+            with tracing.span("drain", n=len(payloads)):
+                return list(payloads)
+
+    threading.Thread(target=loop).start()
+
+
+def whole_context_copied(executor, work):
+    ctx = contextvars.copy_context()
+    executor.submit(ctx.run, work)
+
+
+def accumulator_passed_explicitly(executor):
+    scope = _ACCUMULATOR.get()
+
+    def fold(result):
+        if scope is not None:
+            scope.append(result)
+
+    executor.submit(fold, 1)

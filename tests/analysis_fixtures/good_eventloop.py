@@ -1,0 +1,49 @@
+"""SNAP007 negative fixtures: blocking work routed off the loop."""
+import asyncio
+import subprocess
+import time
+
+
+class ReadHandler:
+    def _read_sync(self, req):
+        return open(req).read()
+
+    async def handle_read(self, req):
+        loop = asyncio.get_running_loop()
+        # Executor-routed: the helper is an argument, not a call.
+        return await loop.run_in_executor(None, self._read_sync, req)
+
+    async def handle_lock(self, req):
+        # asyncio primitives are awaited, not thread-blocking.
+        await self._cache_lock.acquire()
+        try:
+            return self._cache[req]
+        finally:
+            self._cache_lock.release()
+
+    async def handle_lock_with_timeout(self, req):
+        self._fallback_lock.acquire(timeout=0.1)
+        try:
+            return req
+        finally:
+            self._fallback_lock.release()
+
+    def probe(self, cmd):
+        # Blocking in a sync function never called from async code is
+        # fine — it runs wherever its (sync) caller runs.
+        return subprocess.check_output(cmd)
+
+
+def _backoff_helper(seconds):
+    time.sleep(seconds)
+
+
+def sync_retry_loop(op):
+    # sync-to-sync call chain with no async root: not the loop's business.
+    _backoff_helper(0.5)
+    return op()
+
+
+async def drain_step(item):
+    await asyncio.to_thread(_backoff_helper, 0.5)
+    return item

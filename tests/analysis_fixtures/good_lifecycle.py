@@ -1,0 +1,66 @@
+"""SNAP006 negative fixtures: every obligation discharged on all paths."""
+from torchsnapshot_tpu import tracing
+
+
+def released_in_finally(pool, nbytes, consume):
+    lease = pool.acquire(nbytes)
+    try:
+        consume(lease.buffer)
+    finally:
+        lease.release()
+
+
+def conditional_release_joined(pool, nbytes, consume, fast):
+    lease = pool.acquire(nbytes)
+    try:
+        if fast:
+            consume(lease.buffer)
+    finally:
+        lease.release()
+
+
+def ownership_transferred(pool, nbytes, state):
+    lease = pool.acquire(nbytes)
+    state.attach(lease)  # the state object releases at teardown
+
+
+def handle_stored_on_self(self_like, pool, nbytes):
+    self_like._lease = pool.acquire(nbytes)
+
+
+def released_via_closure_handoff(pool, nbytes, executor):
+    lease = pool.acquire(nbytes)
+
+    def done():
+        lease.release()
+
+    executor.submit(done)
+
+
+def write_through_paired_on_all_paths(rt, root, path, write_durable):
+    rt.begin_write_through(root, path)
+    try:
+        write_durable(path)
+    except Exception:
+        rt.abort_write_through(root, path)
+        raise
+    rt.note_write_through(root, path)
+
+
+def budget_handed_off(budget, consumer, cost):
+    budget.charge(cost)
+    consumer.set_cost_releaser(budget.release)
+
+
+def span_as_context_manager(path):
+    with tracing.span("write", path=path):
+        return path
+
+
+def lease_in_loop_released(pool, sizes, consume):
+    for nbytes in sizes:
+        lease = pool.acquire(nbytes)
+        try:
+            consume(lease.buffer)
+        finally:
+            lease.release()

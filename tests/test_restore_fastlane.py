@@ -174,6 +174,63 @@ def test_split_state_budget_recredit_once_through_pool(monkeypatch):
 # ------------------------------------------------- streaming + overlap engine
 
 
+def test_pooled_state_stores_lease_before_anything_else(monkeypatch):
+    """Regression (snapcheck SNAP006): ``_ensure_buf`` must make the
+    lease reachable from the state BEFORE any other work — an exception
+    between acquire and store orphaned the pooled buffer (and its
+    exactly-once budget re-credit) with no owner left to release it."""
+    from torchsnapshot_tpu import io_preparer as iop
+
+    class _BoomLease:
+        def __init__(self):
+            self.released = False
+            self._budget_cb = None
+            self._budget_nbytes = 0
+            self.credits = []
+
+        @property
+        def buffer(self):
+            raise RuntimeError("boom between acquire and store")
+
+        def release(self):
+            self.released = True
+            cb, self._budget_cb = self._budget_cb, None
+            if cb is not None:
+                cb(self._budget_nbytes)
+
+        def set_budget_release(self, cb, nbytes):
+            if self.released:
+                cb(nbytes)
+            else:
+                self._budget_cb = cb
+                self._budget_nbytes = nbytes
+
+    class _FakePool:
+        def __init__(self):
+            self.lease = _BoomLease()
+
+        def acquire(self, nbytes, profile=None):
+            return self.lease
+
+    pool = _FakePool()
+    monkeypatch.setattr(staging_pool, "get_staging_pool", lambda: pool)
+    state = iop._SplitObjectReadState.__new__(iop._SplitObjectReadState)
+    iop._PooledAssemblyState.__init__(state, nbytes=64)
+    credits = []
+    state.set_cost_releaser(credits.append)
+    with pytest.raises(RuntimeError, match="boom"):
+        state._ensure_buf()
+    # The lease is reachable, so the state's release path returns it —
+    # AND the scheduler re-credit the lease never got attached to
+    # still fires, exactly once.
+    assert state._lease is pool.lease
+    state._release_assembly_buffer()
+    assert pool.lease.released
+    assert credits == [64]
+    state._release_assembly_buffer()  # idempotent: no double credit
+    assert credits == [64]
+
+
 def test_streaming_report_moves_h2d_off_the_consume_wall(
     tmp_path, monkeypatch
 ):

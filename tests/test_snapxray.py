@@ -147,6 +147,50 @@ def test_take_and_restore_roots_stamp_distinct_trace_ids(tmp_path):
         assert _trace_ids(events, name) == {restore_id}
 
 
+def test_chunked_take_encode_spans_inherit_take_trace(tmp_path):
+    # Regression (SNAP008 true positive): ChunkStager hands _stage_sync
+    # to the staging executor; without explicit adoption the encode
+    # span ran in a fresh context and attributed to no trace.
+    trace_path = str(tmp_path / "enc.json")
+    tracing.enable(trace_path)
+    root = _mem_root("encode")
+    rng = np.random.default_rng(7)
+    state = {
+        "m": StateDict(
+            w=rng.standard_normal(262144).astype(np.float32)
+        )
+    }
+    Snapshot.take(root, state, chunks=True, codec="zlib")
+    events = _flush_events(trace_path)
+    (take_id,) = _trace_ids(events, "Snapshot.take")
+    encode_traces = _trace_ids(events, "encode")
+    assert encode_traces, "expected encode spans from the codec stage"
+    assert encode_traces == {take_id}, encode_traces
+
+
+def test_finalize_via_pool_keeps_restore_trace(tmp_path, monkeypatch):
+    # Regression (SNAP008 true positive): when finalize hops to the
+    # finalize pool (engine done-callback thread), the assemble span
+    # ran in the pool thread's fresh context. The plan captures the
+    # restore's trace id at plan-build and re-adopts it.
+    import torchsnapshot_tpu.io_preparer as iop
+
+    monkeypatch.setattr(iop, "_on_h2d_engine_thread", lambda: True)
+    trace_path = str(tmp_path / "fin.json")
+    tracing.enable(trace_path)
+    root = _mem_root("finalize")
+    state = _state()
+    Snapshot.take(root, state)
+    target = _zero_like(state)
+    Snapshot(root).restore(target)
+    _assert_exact(target, state)
+    events = _flush_events(trace_path)
+    (restore_id,) = _trace_ids(events, "Snapshot.restore")
+    assemble_traces = _trace_ids(events, "assemble")
+    assert assemble_traces, "expected assemble spans from finalize"
+    assert assemble_traces == {restore_id}, assemble_traces
+
+
 def test_trace_context_cheap_and_absent_outside_roots():
     assert tracing.current_trace_id() is None
     with tracing.trace_scope("take") as tid:

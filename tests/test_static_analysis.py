@@ -25,8 +25,12 @@ import pytest
 from torchsnapshot_tpu import analysis
 from torchsnapshot_tpu.analysis import (
     BlockingSyncRule,
+    ContextPropagationRule,
+    ContractDriftRule,
     DeterminismRule,
     DurabilityOrderRule,
+    EventLoopBlockingRule,
+    LifecycleRule,
     LocksetRule,
     SwallowedExceptionRule,
 )
@@ -62,11 +66,16 @@ def test_repo_is_clean():
 
 
 def test_fixture_corpus_is_dirty():
-    # The bad fixtures must keep firing; a rule that stops seeing them
-    # has silently stopped protecting the package too.
+    # EVERY registered rule id must have at least one firing fixture; a
+    # rule that stops seeing its bad fixture has silently stopped
+    # protecting the package too.
     result = analysis.run([FIXTURES], analysis.default_rules())
     codes = {d.code for d in result.violations}
-    assert codes == {"SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"}
+    assert codes == {r.code for r in analysis.default_rules()}
+    assert codes == {
+        "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+    }
 
 
 # ------------------------------------------------------- SNAP001 blocking-sync
@@ -207,6 +216,199 @@ def test_lockset_is_module_scoped():
     rule = LocksetRule()
     assert rule.applies_to("torchsnapshot_tpu/coord.py")
     assert not rule.applies_to("torchsnapshot_tpu/snapshot.py")
+
+
+# ---------------------------------------------- SNAP006 resource-lifecycle
+
+
+def test_lifecycle_positive():
+    result = analyze("bad_lifecycle.py", [LifecycleRule()])
+    assert findings(result) == [
+        ("SNAP006", 6),   # leaked lease: release skipped on exception edge
+        ("SNAP006", 17),  # double release (finally after conditional)
+        ("SNAP006", 21),  # acquire result discarded
+        ("SNAP006", 25),  # begin_write_through neither noted nor aborted
+        ("SNAP006", 31),  # tracing.span called bare, never entered
+        ("SNAP006", 35),  # release skipped on early return
+    ]
+    msgs = {d.line: d.message for d in result.violations}
+    assert "exception path" in msgs[6]
+    assert "released twice" in msgs[17]
+    assert "discarded" in msgs[21]
+    assert "hottier-write-through" in msgs[25]
+    assert "context manager" in msgs[31]
+
+
+def test_lifecycle_negative():
+    # try/finally releases, ownership transfer (attribute store, call
+    # argument, closure handoff, bound-method releaser), context-managed
+    # spans, and loop-scoped leases are all clean.
+    result = analyze("good_lifecycle.py", [LifecycleRule()])
+    assert findings(result) == []
+
+
+def test_lifecycle_except_exception_cleanup_counts():
+    # An `except Exception: release; raise` discharges the exceptional
+    # path — what escapes it is tearing down the process.
+    source = (
+        "def f(pool, n, use):\n"
+        "    lease = pool.acquire(n)\n"
+        "    try:\n"
+        "        use(lease)\n"
+        "    except Exception:\n"
+        "        lease.release()\n"
+        "        raise\n"
+        "    lease.release()\n"
+    )
+    result = analysis.analyze_source(source, "x.py", [LifecycleRule()])
+    assert result.diagnostics == []
+
+
+def test_lifecycle_while_true_has_no_false_exit():
+    # `while True:` only exits via break; the path that releases before
+    # breaking is the only exit path, so no leak.
+    source = (
+        "def f(pool, n, step):\n"
+        "    lease = pool.acquire(n)\n"
+        "    try:\n"
+        "        while True:\n"
+        "            if step():\n"
+        "                break\n"
+        "    finally:\n"
+        "        lease.release()\n"
+    )
+    result = analysis.analyze_source(source, "x.py", [LifecycleRule()])
+    assert result.diagnostics == []
+
+
+def test_lifecycle_return_routes_through_finally():
+    source = (
+        "def f(pool, n, cond):\n"
+        "    lease = pool.acquire(n)\n"
+        "    try:\n"
+        "        if cond:\n"
+        "            return 1\n"
+        "        return 2\n"
+        "    finally:\n"
+        "        lease.release()\n"
+    )
+    result = analysis.analyze_source(source, "x.py", [LifecycleRule()])
+    assert result.diagnostics == []
+
+
+# --------------------------------------------- SNAP007 event-loop-blocking
+
+
+def test_eventloop_positive():
+    result = analyze("bad_eventloop.py", [EventLoopBlockingRule()])
+    assert findings(result) == [
+        ("SNAP007", 13),  # sync storage helper in async handler
+        ("SNAP007", 16),  # untimed lock.acquire in async handler
+        ("SNAP007", 23),  # subprocess wait in async handler
+        ("SNAP007", 27),  # time.sleep transitively reachable from async
+    ]
+    transitive = [d for d in result.violations if d.line == 27]
+    assert "drain_step" in transitive[0].message  # names the async origin
+
+
+def test_eventloop_negative():
+    # run_in_executor/to_thread routing, awaited asyncio primitives,
+    # timeouts, and purely-sync call chains are all clean.
+    result = analyze("good_eventloop.py", [EventLoopBlockingRule()])
+    assert findings(result) == []
+
+
+def test_eventloop_does_not_duplicate_snap001_in_async_bodies():
+    # time.sleep directly inside an async def is SNAP001's finding;
+    # SNAP007 must not double-report it.
+    source = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+    )
+    r7 = analysis.analyze_source(
+        source, "x.py", [EventLoopBlockingRule()]
+    )
+    assert r7.diagnostics == []
+    r1 = analysis.analyze_source(source, "x.py", [BlockingSyncRule()])
+    assert [d.code for d in r1.diagnostics] == ["SNAP001"]
+
+
+# -------------------------------------------- SNAP008 context-propagation
+
+
+def test_context_positive():
+    result = analyze("bad_context.py", [ContextPropagationRule()])
+    assert findings(result) == [
+        ("SNAP008", 12),  # executor callback reads current_trace_id
+        ("SNAP008", 19),  # thread target emits a span unadopted
+        ("SNAP008", 27),  # callback reads a registered ContextVar
+    ]
+
+
+def test_context_negative():
+    # Capture-outside-closure, adopt_trace wrapping, copy_context().run
+    # submission, and explicit value passing are all clean.
+    result = analyze("good_context.py", [ContextPropagationRule()])
+    assert findings(result) == []
+
+
+def test_context_skips_defs_nested_in_submitted_callable():
+    # A helper defined INSIDE the submitted callable runs only when the
+    # (adopted) body invokes it — the read inside it must not fire.
+    source = (
+        "from torchsnapshot_tpu import tracing\n"
+        "def go(executor, tid):\n"
+        "    def work():\n"
+        "        def helper():\n"
+        "            return tracing.current_trace_id()\n"
+        "        with tracing.adopt_trace(tid):\n"
+        "            return helper()\n"
+        "    executor.submit(work)\n"
+    )
+    result = analysis.analyze_source(
+        source, "x.py", [ContextPropagationRule()]
+    )
+    assert result.diagnostics == []
+
+
+# ------------------------------------------------ SNAP009 contract-drift
+
+
+def test_contract_drift_positive_all_arms():
+    result = analyze("contract_tree", [ContractDriftRule()])
+    by_arm = sorted(
+        (d.message.split("'")[1], os.path.basename(d.path))
+        for d in result.violations
+    )
+    assert by_arm == [
+        ("TPUSNAPSHOT_FIXTURE_KNOB", "knobs.py"),
+        ("fixture-undocumented-rule", "doctor.py"),
+        ("fixture_undocumented", "schedule.py"),
+        ("fixture_undocumented_field", "ledger.py"),
+        ("tpusnapshot_fixture_undocumented_total", "metrics.py"),
+    ]
+    # The acceptance-criteria arm: a fixture env knob absent from the
+    # fixture doc fails the run.
+    assert any(
+        "TPUSNAPSHOT_FIXTURE_KNOB" in d.message
+        and "docs/api.md" in d.message
+        for d in result.violations
+    )
+
+
+def test_contract_drift_negative():
+    result = analyze("contract_tree_good", [ContractDriftRule()])
+    assert findings(result) == []
+
+
+def test_contract_drift_resolves_repo_docs_for_package_files():
+    # Analyzing a real package file must resolve to the repo's docs/
+    # tree (walking up from the file), not require a fixture tree.
+    target = os.path.join(PACKAGE, "staging_pool.py")
+    result = analysis.run([target], [ContractDriftRule()])
+    # staging_pool's knobs are documented in docs/api.md.
+    assert findings(result) == []
 
 
 # -------------------------------------------------------------- suppressions
@@ -378,11 +580,17 @@ def test_baseline_fingerprint_survives_line_drift():
 
 
 def test_select_rules():
-    assert len(analysis.select_rules(None)) == 5
+    assert len(analysis.select_rules(None)) == 9
     by_name = analysis.select_rules(["blocking-sync", "lockset"])
     assert sorted(r.code for r in by_name) == ["SNAP001", "SNAP005"]
     by_code = analysis.select_rules(["SNAP002"])
     assert [r.name for r in by_code] == ["durability-order"]
+    flow = analysis.select_rules(
+        ["resource-lifecycle", "SNAP007", "context-propagation", "SNAP009"]
+    )
+    assert sorted(r.code for r in flow) == [
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+    ]
     with pytest.raises(ValueError, match="Unknown rule"):
         analysis.select_rules(["no-such-rule"])
 
@@ -444,7 +652,10 @@ def test_cli_dirty_on_fixture_corpus_json():
     doc = json.loads(proc.stdout)
     assert doc["ok"] is False
     codes = {v["code"] for v in doc["violations"]}
-    assert codes == {"SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"}
+    assert codes == {
+        "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+    }
     sample = doc["violations"][0]
     # Machine-readable contract: rule id, stable code, location, message.
     assert set(sample) >= {"rule", "code", "path", "line", "col", "message"}
@@ -479,5 +690,213 @@ def test_cli_rule_filter_and_usage_errors():
 def test_cli_list_rules():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
-    for code in ("SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005"):
+    for code in (
+        "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+    ):
         assert code in proc.stdout
+
+
+# ------------------------------------------------------------------ SARIF
+
+
+def test_cli_sarif_output_shape():
+    proc = run_cli(
+        "--format", "sarif",
+        os.path.join(FIXTURES, "bad_lifecycle.py"),
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run0 = doc["runs"][0]
+    driver = run0["tool"]["driver"]
+    assert driver["name"] == "snapcheck"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert "SNAP006" in rule_ids
+    results = run0["results"]
+    assert results, "expected findings in SARIF results"
+    sample = results[0]
+    assert sample["ruleId"].startswith("SNAP")
+    assert sample["level"] == "error"
+    loc = sample["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad_lifecycle.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_sarif_clean_exits_zero():
+    proc = run_cli(
+        "--format", "sarif",
+        os.path.join(FIXTURES, "good_lifecycle.py"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_sarif_baselined_findings_marked(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_swallowed.py")
+    baseline = str(tmp_path / "baseline.json")
+    assert run_cli("--write-baseline", baseline, bad).returncode == 0
+    proc = run_cli("--format", "sarif", "--baseline", baseline, bad)
+    assert proc.returncode == 0
+    results = json.loads(proc.stdout)["runs"][0]["results"]
+    assert results
+    assert all(r["baselineState"] == "unchanged" for r in results)
+    assert all(r["level"] == "note" for r in results)
+
+
+# ----------------------------------------------------------- changed-only
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={
+            **os.environ,
+            "GIT_AUTHOR_NAME": "t",
+            "GIT_AUTHOR_EMAIL": "t@t",
+            "GIT_COMMITTER_NAME": "t",
+            "GIT_COMMITTER_EMAIL": "t@t",
+        },
+    )
+
+
+def run_cli_in(cwd, *args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # The tmp repo is outside the source tree; keep the package
+    # importable without an install.
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=env,
+        timeout=120,
+    )
+
+
+def test_cli_changed_only(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    assert _git(repo, "init", "-q").returncode == 0
+    committed = repo / "committed.py"
+    committed.write_text(
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    _git(repo, "add", ".")
+    assert _git(repo, "commit", "-q", "-m", "seed").returncode == 0
+
+    # Nothing changed vs HEAD: exit 0 even though committed.py is dirty
+    # by SNAP003 — the fast pre-commit path only lints the diff.
+    clean = run_cli_in(repo, "--changed-only", "HEAD", ".")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "nothing to analyze" in clean.stdout
+
+    # An untracked new file with a finding fails; the committed file's
+    # pre-existing finding still does not enter the run.
+    newfile = repo / "new.py"
+    newfile.write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+    )
+    dirty = run_cli_in(repo, "--changed-only", "HEAD", ".")
+    assert dirty.returncode == 1
+    assert "new.py" in dirty.stdout
+    assert "committed.py" not in dirty.stdout
+
+    # A bad ref is a usage error.
+    bad_ref = run_cli_in(repo, "--changed-only", "no-such-ref", ".")
+    assert bad_ref.returncode == 2
+
+
+def test_cli_changed_only_sees_untracked_files_from_subdir(tmp_path):
+    # `git ls-files --others` is cwd-relative; run from a subdirectory
+    # the untracked file must still be joined to the repo root
+    # correctly, or the pre-commit gate silently passes a violation.
+    repo = tmp_path / "repo"
+    sub = repo / "sub"
+    sub.mkdir(parents=True)
+    assert _git(repo, "init", "-q").returncode == 0
+    (repo / "seed.py").write_text("X = 1\n")
+    _git(repo, "add", ".")
+    assert _git(repo, "commit", "-q", "-m", "seed").returncode == 0
+    (sub / "new.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+    )
+    dirty = run_cli_in(sub, "--changed-only", "HEAD", ".")
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    assert "new.py" in dirty.stdout
+
+
+# ----------------------------------------------------- suppression gate
+
+
+def test_cli_max_suppressions_gate(tmp_path):
+    target = tmp_path / "suppressed_only.py"
+    target.write_text(
+        "def swallow(op):\n"
+        "    try:\n"
+        "        return op()\n"
+        "    except Exception:  # snapcheck: disable=SNAP003 -- probe\n"
+        "        return None\n"
+    )
+    ok = run_cli("--max-suppressions", "1", str(target))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    blown = run_cli("--max-suppressions", "0", str(target))
+    assert blown.returncode == 1
+    assert "--max-suppressions" in blown.stderr
+    # JSON `ok` must agree with the exit status when a gate trips.
+    blown_json = run_cli(
+        "--format", "json", "--max-suppressions", "0", str(target)
+    )
+    assert blown_json.returncode == 1
+    assert json.loads(blown_json.stdout)["ok"] is False
+
+
+# ------------------------------------------------------- baseline drift
+
+
+def test_cli_fail_stale_baseline(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_swallowed.py")
+    clean = os.path.join(FIXTURES, "good_swallowed.py")
+    baseline = str(tmp_path / "baseline.json")
+    assert run_cli("--write-baseline", baseline, bad).returncode == 0
+
+    # The baseline's findings no longer match anything when run against
+    # the clean file: without the flag that is tolerated...
+    tolerated = run_cli("--baseline", baseline, clean)
+    assert tolerated.returncode == 0
+    # ...with the flag it is baseline rot and fails.
+    stale = run_cli("--fail-stale-baseline", "--baseline", baseline, clean)
+    assert stale.returncode == 1
+    assert "stale baseline" in stale.stderr
+
+    # A fully-consumed baseline passes the drift check.
+    fresh = run_cli("--fail-stale-baseline", "--baseline", baseline, bad)
+    assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+
+
+def test_run_result_reports_stale_entries():
+    bad = os.path.join(FIXTURES, "bad_swallowed.py")
+    rules = [SwallowedExceptionRule()]
+    first = analysis.run([bad], rules)
+    fake = dict.fromkeys(first.fingerprints, 1)
+    fake["swallowed-exception::gone.py::deadbeef0000"] = 2
+    result = analysis.run([bad], rules, baseline=fake)
+    assert result.ok
+    assert result.stale_baseline == {
+        "swallowed-exception::gone.py::deadbeef0000": 2
+    }

@@ -1,7 +1,10 @@
 """snapcheck: checkpoint-safety static analysis for torchsnapshot_tpu.
 
 An AST-based, pluggable lint framework encoding this framework's own
-safety invariants as CI-gated rules (see ``docs/ANALYSIS.md``):
+safety invariants as CI-gated rules (see ``docs/ANALYSIS.md``).
+SNAP001-005 are syntactic; SNAP006-008 are flow-sensitive (statement-
+level CFGs + forward dataflow, ``cfg.py``/``dataflow.py``); SNAP009 is
+cross-artifact (code vs ``docs/``):
 
 ==========  =====================  ==========================================
 Code        Rule                   Invariant
@@ -14,12 +17,23 @@ SNAP003     swallowed-exception    retry/commit paths never discard failures
 SNAP004     nondeterminism         fingerprint/manifest serialization is
                                    reproducible
 SNAP005     lockset                lock-owning state mutated under its lock
+SNAP006     resource-lifecycle     acquire/release obligations (leases,
+                                   budget holds, write-throughs, spans)
+                                   discharge exactly once on every path
+SNAP007     event-loop-blocking    blocking calls never reachable from
+                                   async code without an executor hop
+SNAP008     context-propagation    contextvar readers in submitted
+                                   callables adopt their context
+SNAP009     contract-drift         env knobs / metrics / doctor rules /
+                                   ledger fields / fault kinds stay in
+                                   sync with their docs
 ==========  =====================  ==========================================
 
 Run it::
 
     python -m torchsnapshot_tpu.analysis torchsnapshot_tpu/
     python -m torchsnapshot_tpu.analysis --format json --baseline b.json src/
+    python -m torchsnapshot_tpu.analysis --format sarif --changed-only HEAD src/
 
 Suppress a deliberate violation with a justification::
 
@@ -47,9 +61,13 @@ from .core import (
     save_baseline,
 )
 from .rules_async import BlockingSyncRule
+from .rules_context import ContextPropagationRule
+from .rules_contracts import ContractDriftRule
 from .rules_determinism import DeterminismRule
 from .rules_durability import DurabilityOrderRule
+from .rules_eventloop import EventLoopBlockingRule
 from .rules_exceptions import SwallowedExceptionRule
+from .rules_lifecycle import LifecycleRule
 from .rules_lockset import LocksetRule
 
 
@@ -61,6 +79,10 @@ def default_rules() -> List[Rule]:
         SwallowedExceptionRule(),
         DeterminismRule(),
         LocksetRule(),
+        LifecycleRule(),
+        EventLoopBlockingRule(),
+        ContextPropagationRule(),
+        ContractDriftRule(),
     ]
 
 
@@ -83,10 +105,14 @@ def select_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
 
 __all__ = [
     "BlockingSyncRule",
+    "ContextPropagationRule",
+    "ContractDriftRule",
     "DeterminismRule",
     "Diagnostic",
     "DurabilityOrderRule",
+    "EventLoopBlockingRule",
     "FileResult",
+    "LifecycleRule",
     "LocksetRule",
     "Rule",
     "RunResult",

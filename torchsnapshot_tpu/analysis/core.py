@@ -289,6 +289,11 @@ class RunResult:
     suppressed: List[Diagnostic]
     errors: List[Tuple[str, str]]  # (path, message)
     fingerprints: List[str]  # of every violation incl. baselined
+    # Baseline entries that matched no current finding (count left
+    # over). Stale entries are baseline rot: the finding was fixed (or
+    # the code deleted) but the mask lives on, ready to hide the next
+    # regression at the same fingerprint.
+    stale_baseline: Dict[str, int] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -326,6 +331,9 @@ def run(
         suppressed=suppressed,
         errors=errors,
         fingerprints=all_fps,
+        stale_baseline={
+            fp: n for fp, n in sorted(remaining.items()) if n > 0
+        },
     )
 
 

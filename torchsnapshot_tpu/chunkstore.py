@@ -344,9 +344,19 @@ class ChunkStager(ArrayBufferStager):
 
     async def stage_buffer(self, executor=None):
         if executor is None:
-            return self._stage_sync()
+            # Inline-staging escape hatch: every pipeline path passes an
+            # executor; a caller opting out owns the stall trade-off.
+            return self._stage_sync()  # snapcheck: disable=event-loop-blocking -- executor=None is the caller-owned inline path; all pipeline call sites pass an executor
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(executor, self._stage_sync)
+        # The executor thread's fresh context would attribute the encode
+        # span to no trace — carry the take's trace id across the hop.
+        tid = tracing.current_trace_id()
+
+        def _stage_adopted():
+            with tracing.adopt_trace(tid):
+                return self._stage_sync()
+
+        return await loop.run_in_executor(executor, _stage_adopted)
 
     def _stage_sync(self):
         import jax

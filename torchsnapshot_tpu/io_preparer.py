@@ -261,7 +261,9 @@ class ArrayBufferStager(BufferStager):
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         loop = asyncio.get_running_loop()
         if executor is None:
-            return self._stage_sync()
+            # Inline-staging escape hatch: every pipeline path passes an
+            # executor; a caller opting out owns the stall trade-off.
+            return self._stage_sync()  # snapcheck: disable=event-loop-blocking -- executor=None is the caller-owned inline path; all pipeline call sites pass an executor
         return await loop.run_in_executor(executor, self._stage_sync)
 
     def _stage_sync(self) -> BufferType:
@@ -662,9 +664,12 @@ class _PooledAssemblyState:
                 self._buf = bytearray(self.nbytes)
                 return
             lease = pool.acquire(self.nbytes, self._profile)
-            release, self._cost_release = self._cost_release, None
+            # Store the lease before touching anything else: until it
+            # is reachable from self, an exception here would orphan
+            # the pooled buffer (and its exactly-once budget re-credit).
             self._lease = lease
             self._buf = lease.buffer
+            release, self._cost_release = self._cost_release, None
         if release is not None:
             lease.set_budget_release(release, self.nbytes)
 
@@ -677,6 +682,12 @@ class _PooledAssemblyState:
             release, self._cost_release = self._cost_release, None
             self._buf = None
         if lease is not None:
+            if release is not None:
+                # _ensure_buf stored the lease but raised before
+                # handing it the releaser: attach before releasing so
+                # the budget re-credit still fires (exactly once — the
+                # lease owns it from here).
+                lease.set_budget_release(release, self.nbytes)
             lease.release()
         elif release is not None:
             release(self.nbytes)
@@ -1293,6 +1304,12 @@ class ArrayRestorePlan:
         self._dtype = str_to_dtype(dtype_name)
         self._shape = shape
         self._prng_impl = getattr(entry, "prng_impl", None)
+        # Plan-build runs in the restoring thread, under the restore's
+        # trace scope; finalize may instead run on the finalize pool or
+        # an engine done-callback thread, whose fresh contexts would
+        # attribute the assemble span to no trace. Capture now, adopt
+        # in _finalize_now.
+        self._trace_id = tracing.current_trace_id()
 
         if (
             self._prng_impl is not None
@@ -1698,7 +1715,9 @@ class ArrayRestorePlan:
     def _finalize_now(self) -> None:
         try:
             self._await_pipeline()
-            with tracing.span("assemble"):
+            with tracing.adopt_trace(self._trace_id), tracing.span(
+                "assemble"
+            ):
                 self._finalize_impl()
         except BaseException as e:  # noqa: BLE001 — SimulatedCrash must surface
             # When this runs on the finalize pool the raise lands in an

@@ -442,6 +442,15 @@ async def execute_read_reqs(
                 nothing_in_flight = not (reading or consumable or consuming)
                 if budget.value >= cost or nothing_in_flight:
                     rr = pending.popleft()
+                    # Invariant the flow analysis cannot see: every
+                    # charge is re-credited when its read/consume task
+                    # completes in a LATER loop iteration (the
+                    # budget.release below / the consumer's deferred
+                    # releaser), and the cell is per-pipeline-run — a
+                    # failed run gang-cancels its tasks and drops the
+                    # cell with the stack frame, so no charge outlives
+                    # the budget it was charged against.
+                    # snapcheck: disable=resource-lifecycle -- cross-iteration discharge: released at task completion (below) or via the consumer's deferred releaser; cell dies with the run
                     budget.charge(cost)
                     min_budget = min(min_budget, budget.value)
                     deferred = consumer.get_deferred_cost_bytes()
