@@ -1,0 +1,587 @@
+"""snapwire: the hot tier's REAL cross-host transport — ack-at-k over
+the wire, delta replication, and the network fault matrix.
+
+Fast tier (``-m faultline``, runs in tier-1): the over-the-wire
+ack-at-k contract (replicas fingerprint-verified by the receiving peer
+process before the ack), delta pushes costing exactly the changed-chunk
+bytes, an unchanged retake's delta_ratio < 10%, torn-frame /
+drop_conn / slow_wire determinism, a real-SIGKILL host-loss ×
+crash-point stride subset (full enumeration ``-m slow``),
+restore-from-peer after a real process kill, the lose_host
+blocked-read abort contract, capacity-refusal spare substitution, the
+replication telemetry window (report / ledger / doctor), and the
+``TPUSNAPSHOT_HOT_TIER_ADDRS`` address book.
+
+In-process peers (``start_local_peer``) carry real TCP sockets without
+subprocess spawn cost; the SIGKILL scenarios use real ``spawn_peer``
+subprocesses — killing the process IS the host loss.
+"""
+
+import asyncio
+import json
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict, hottier
+from torchsnapshot_tpu import faultline as fl
+from torchsnapshot_tpu import wire
+from torchsnapshot_tpu.hottier import tier as ht_tier
+from torchsnapshot_tpu.hottier import transport
+from torchsnapshot_tpu.hottier.peer import spawn_peer, start_local_peer
+from torchsnapshot_tpu.io_types import IOReq
+from torchsnapshot_tpu.snapserve import protocol as snapserve_protocol
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+from torchsnapshot_tpu.telemetry.doctor import diagnose_report
+
+pytestmark = pytest.mark.faultline
+
+
+# ----------------------------------------------------------------- helpers
+
+
+@pytest.fixture(autouse=True)
+def _fresh_wire(monkeypatch):
+    """Every test starts with an empty tier, no registered peers, no
+    scripted wire faults, and tight (fast-failing) wire knobs."""
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_DEADLINE_S", "2")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S", "3")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_DOWN_COOLDOWN_S", "0.2")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_CODEC", "none")
+    hottier.disable_hot_tier(flush=False)
+    hottier.reset_hot_tier()
+    transport.clear_wire_faults()
+    servers = []
+    yield servers
+    hottier.disable_hot_tier(flush=False)
+    hottier.reset_hot_tier()  # closes RemotePeers, kills spawned procs
+    transport.clear_wire_faults()
+    for server in servers:
+        server.stop()
+
+
+def _local_peer(servers, host_id, capacity_bytes=1 << 26):
+    server, peer = start_local_peer(host_id, capacity_bytes=capacity_bytes)
+    servers.append(server)
+    return peer
+
+
+def _state(v, n=2048):
+    return {"s": StateDict(w=jnp.full((n,), float(v), dtype=jnp.float32))}
+
+
+def _target(n=2048):
+    return {"s": StateDict(w=jnp.zeros((n,), dtype=jnp.float32))}
+
+
+def _assert_restored(target, v):
+    np.testing.assert_array_equal(np.asarray(target["s"]["w"]), float(v))
+
+
+def _read_report(root):
+    plugin = url_to_storage_plugin(root)
+    try:
+        req = IOReq(path=".report.json")
+        asyncio.run(plugin.read(req))
+        return json.loads(bytes(req.data).decode("utf-8"))
+    finally:
+        plugin.close()
+
+
+# -------------------------------------------------------- framing contract
+
+
+def test_wire_framing_shared_with_snapserve():
+    """snapserve/protocol.py is a re-export of the shared wire module:
+    same callables, bit-identical frames — the extraction is
+    structurally incapable of drift."""
+    assert snapserve_protocol.send_frame is wire.send_frame
+    assert snapserve_protocol.recv_frame is wire.recv_frame
+    assert snapserve_protocol.error_to_wire is wire.error_to_wire
+    assert snapserve_protocol.ProtocolError is wire.ProtocolError
+    assert snapserve_protocol.InvalidRange is wire.InvalidRange
+    frame = wire.encode_frame({"op": "read", "v": 1}, b"payload")
+    # !I header-len, !Q payload-len, sorted-keys JSON, raw payload.
+    header = json.dumps({"op": "read", "v": 1}, sort_keys=True).encode()
+    assert frame == (
+        len(header).to_bytes(4, "big")
+        + len(b"payload").to_bytes(8, "big")
+        + header
+        + b"payload"
+    )
+
+
+# --------------------------------------------------------------- ack-at-k
+
+
+def test_ack_at_k_over_the_wire(_fresh_wire):
+    """k=3 across one local + two wire peers: the take acks only after
+    every replica crossed a process-visible socket and was fingerprint-
+    verified by the receiver; both peers actually hold the bytes."""
+    peer1 = _local_peer(_fresh_wire, 1)
+    peer2 = _local_peer(_fresh_wire, 2)
+    path = "memory://wire-ack/run/step_0"
+    before = transport.wire_stats_snapshot()
+    with hottier.hot_tier(rank=0, world=3, k=3, drain="manual"):
+        snap = Snapshot.take(path, _state(7.0))
+        for peer in (peer1, peer2):
+            q = peer.query(path + "/0/s/w")
+            assert q is not None and q["nbytes"] == 2048 * 4
+        after = transport.wire_stats_snapshot()
+        assert after["pushes"] - before["pushes"] == 2
+        assert (
+            after["payload_bytes"] - before["payload_bytes"] == 2 * 8192
+        )
+        # Kill the local host: the restore is served from a surviving
+        # WIRE replica, bit-exact.
+        ht_tier.kill_host(0)
+        target = _target()
+        snap.restore(target)
+        _assert_restored(target, 7.0)
+        rt = hottier.runtime()
+        assert rt.stats_snapshot()["hot_objects"] >= 1
+        hottier.drain_now()
+
+
+def test_corrupt_push_never_acks(_fresh_wire):
+    """The receiver's ack gate: a push whose reconstruction does not
+    fingerprint back to the pushed tag is NACKed and stores nothing."""
+    peer = _local_peer(_fresh_wire, 1)
+    data = b"x" * 4096
+    resp, _ = peer._call(
+        {
+            "v": wire.PROTOCOL_VERSION,
+            "op": "put",
+            "key": "memory://wire-corrupt/run/step_0/0/s/w",
+            "root": "memory://wire-corrupt/run/step_0",
+            "tag": "bogus-tag",
+            "size": len(data),
+            "lossy": False,
+            "frames": [["raw", 0, len(data), len(data), None]],
+        },
+        data,
+    )
+    assert resp["ok"] is False
+    assert resp["error"]["kind"] == "corrupt_push"
+    assert peer.query("memory://wire-corrupt/run/step_0/0/s/w") is None
+
+
+def test_capacity_refusal_substitutes_spare_host(_fresh_wire):
+    """A wire peer refusing for capacity is not an ack: placement
+    continues to the spare host and the object still reaches k replicas
+    without a write-through."""
+    _local_peer(_fresh_wire, 1, capacity_bytes=64)  # refuses everything
+    path = "memory://wire-cap/run/step_0"
+    with hottier.hot_tier(rank=0, world=3, k=2, drain="manual"):
+        Snapshot.take(path, _state(3.0))
+        rt = hottier.runtime()
+        stats = rt.stats_snapshot()
+        assert stats["write_through"] == 0
+        assert stats["replicas"] == 2  # host 0 + spare host 2
+        key = path + "/0/s/w"
+        assert sorted(ht_tier.replica_hosts_for(key)) == [0, 2]
+        hottier.drain_now()
+
+
+# ----------------------------------------------------------------- deltas
+
+
+def test_delta_push_costs_changed_chunk_bytes(_fresh_wire, monkeypatch):
+    """A partially-dirty retake's push carries exactly the changed
+    chunks (chunkstore-style fingerprints are the diff key); unchanged
+    chunks travel as ref frames costing zero payload bytes."""
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_CHUNK_BYTES", "1024")
+    peer = _local_peer(_fresh_wire, 1)
+    root0, root1 = (
+        "memory://wire-delta/run/step_0",
+        "memory://wire-delta/run/step_1",
+    )
+    base = np.arange(4096, dtype=np.float32)  # 16 KiB = 16 chunks
+    data0 = base.tobytes()
+    ht_tier.put_replica(
+        root0 + "/0/s/w", 1, data0, ht_tier.payload_tag(data0), root0
+    )
+    dirty = base.copy()
+    dirty[:256] += 1.0  # exactly the first 1024-byte chunk
+    data1 = dirty.tobytes()
+    before = transport.wire_stats_snapshot()
+    ht_tier.put_replica(
+        root1 + "/0/s/w", 1, data1, ht_tier.payload_tag(data1), root1
+    )
+    after = transport.wire_stats_snapshot()
+    assert after["wire_bytes"] - before["wire_bytes"] == 1024
+    assert peer.get(root1 + "/0/s/w").data == data1
+    # Fully-unchanged retake: pure-ref push, zero payload bytes.
+    root2 = "memory://wire-delta/run/step_2"
+    before = transport.wire_stats_snapshot()
+    ht_tier.put_replica(
+        root2 + "/0/s/w", 1, data1, ht_tier.payload_tag(data1), root2
+    )
+    after = transport.wire_stats_snapshot()
+    assert after["wire_bytes"] - before["wire_bytes"] == 0
+    assert peer.get(root2 + "/0/s/w").data == data1
+
+
+def test_unchanged_retake_delta_ratio_under_10pct(_fresh_wire, monkeypatch):
+    """The acceptance number end-to-end: an unchanged retake through
+    Snapshot.take replicates < 10% of its payload bytes over the wire,
+    and the take report's tier.replication.delta_ratio certifies it."""
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_CHUNK_BYTES", "4096")
+    _local_peer(_fresh_wire, 1)
+    state = _state(11.0, n=8192)
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        Snapshot.take("memory://wire-retake/run/step_0", state)
+        root1 = "memory://wire-retake/run/step_1"
+        Snapshot.take(root1, state)  # unchanged
+        hottier.drain_now()
+    report = _read_report(root1)
+    rep = report["ranks"][0]["tier"]["replication"]
+    assert rep["pushes"] >= 1
+    assert rep["payload_bytes"] >= 8192 * 4
+    assert rep["delta_ratio"] < 0.10
+
+
+def test_stale_basis_recovers_with_full_push(_fresh_wire):
+    """A peer that lost the delta basis (restart/eviction modeled by
+    dropping the base replica) answers stale_basis; the client re-pushes
+    full and converges — never a wrong replica, never a hang."""
+    peer = _local_peer(_fresh_wire, 1)
+    root0, root1 = (
+        "memory://wire-stale/run/step_0",
+        "memory://wire-stale/run/step_1",
+    )
+    data = np.arange(4096, dtype=np.float32).tobytes()
+    ht_tier.put_replica(
+        root0 + "/0/s/w", 1, data, ht_tier.payload_tag(data), root0
+    )
+    # The peer loses the basis replica behind the client's back.
+    resp, _ = peer._call(
+        {
+            "v": wire.PROTOCOL_VERSION,
+            "op": "drop",
+            "key": root0 + "/0/s/w",
+        }
+    )
+    assert resp["ok"]
+    ht_tier.put_replica(
+        root1 + "/0/s/w", 1, data, ht_tier.payload_tag(data), root1
+    )
+    assert peer.get(root1 + "/0/s/w").data == data
+
+
+def test_int8_optin_lossy_wire_replica(_fresh_wire, monkeypatch):
+    """Opt-in int8 moments replication: the wire carries quantized
+    frames, the peer stores the DEQUANTIZED moments under their own
+    verified tag (bounded error), and the drain persists the EXACT
+    bytes from the local replica — the durable tier never sees lossy
+    data."""
+    from torchsnapshot_tpu import codecs
+
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_INT8_GLOBS", "*opt*")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_CHUNK_BYTES", "4096")
+    peer = _local_peer(_fresh_wire, 1)
+    root = "memory://wire-int8/run/step_0"
+    key = root + "/0/opt/m"
+    rng = np.random.default_rng(7)
+    moments = rng.standard_normal(4096).astype(np.float32)
+    data = moments.tobytes()
+    tag = ht_tier.payload_tag(data)
+    before = transport.wire_stats_snapshot()
+    assert ht_tier.put_replica(key, 1, data, tag, root)
+    after = transport.wire_stats_snapshot()
+    # Quantized frames cross the wire at ~1/4 the float32 payload.
+    assert after["wire_bytes"] - before["wire_bytes"] < len(data) // 2
+    obj = peer.get(key)
+    assert obj.tag != tag  # lossy replica carries its OWN verified tag
+    approx = np.frombuffer(obj.data, dtype=np.float32)
+    bound = codecs.quant_error_bound(moments)
+    assert float(np.max(np.abs(approx - moments))) <= bound + 1e-6
+    # key_tag answers the LOGICAL tag (the drain item's match key), so
+    # the lossy replica can never satisfy a drain probe.
+    assert ht_tier.key_tag(key) == tag
+
+
+# ------------------------------------------------------------- wire faults
+
+
+def test_torn_frame_is_deterministic_and_never_acks(_fresh_wire):
+    """faultline's torn_frame at a replicate boundary: the torn attempt
+    never acks (the receiver's readexactly observes the tear), the
+    retry converges, and the fault record is deterministic."""
+    peer = _local_peer(_fresh_wire, 1)
+    sched = fl.FaultSchedule().torn_frame(host=1, path="host1:*")
+    path = "memory://wire-torn/run/step_0"
+    before = transport.wire_stats_snapshot()
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        with fl.inject(sched) as ctl:
+            snap = Snapshot.take(path, _state(5.0))
+        assert ctl.fault_counts() == {"torn_frame": 1}
+        after = transport.wire_stats_snapshot()
+        assert after["retries"] - before["retries"] >= 1
+        assert peer.query(path + "/0/s/w") is not None
+        target = _target()
+        snap.restore(target)
+        _assert_restored(target, 5.0)
+        hottier.drain_now()
+
+
+def test_torn_frames_exhaust_budget_then_degrade(_fresh_wire):
+    """Every attempt torn: the push exhausts its retry budget, the
+    object is written through to the durable tier BEFORE the ack (the
+    obligation is never lost), the peer holds nothing, and the restore
+    is bit-exact."""
+    peer = _local_peer(_fresh_wire, 1)
+    for _ in range(64):  # enough for every retry inside the budget
+        transport.script_wire_fault("torn_frame", host=1)
+    path = "memory://wire-torn-all/run/step_0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        t0 = time.monotonic()
+        snap = Snapshot.take(path, _state(6.0))
+        assert time.monotonic() - t0 < 20.0  # bounded, no hang
+        rt = hottier.runtime()
+        stats = rt.stats_snapshot()
+        assert stats["write_through"] == 1
+        assert stats["degraded_puts"] == 1
+        transport.clear_wire_faults()
+        time.sleep(0.25)  # wait out the down cooldown
+        assert peer.probe()  # the peer itself is healthy — only the
+        assert peer.query(path + "/0/s/w") is None  # pushes tore; never acked
+        target = _target()
+        snap.restore(target)
+        _assert_restored(target, 6.0)
+        hottier.drain_now()
+    report = _read_report(path)
+    findings = {f.rule: f.severity for f in diagnose_report(report)}
+    assert findings.get("replication-degraded") == "critical"
+
+
+def test_drop_conn_retry_converges(_fresh_wire):
+    peer = _local_peer(_fresh_wire, 1)
+    sched = fl.FaultSchedule().drop_conn(host=1, path="host1:*")
+    path = "memory://wire-drop/run/step_0"
+    before = transport.wire_stats_snapshot()
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        with fl.inject(sched) as ctl:
+            Snapshot.take(path, _state(9.0))
+        assert ctl.fault_counts() == {"drop_conn": 1}
+        after = transport.wire_stats_snapshot()
+        assert after["retries"] - before["retries"] >= 1
+        assert after["pushes"] - before["pushes"] == 1
+        assert peer.query(path + "/0/s/w") is not None
+        hottier.drain_now()
+
+
+def test_slow_wire_misses_deadline_deterministically(_fresh_wire):
+    """slow_wire above the RPC deadline: exactly one counted deadline
+    miss, then the retry (unscripted) lands the push; the take report's
+    replication window carries the miss and the doctor warns."""
+    _local_peer(_fresh_wire, 1)
+    sched = fl.FaultSchedule().slow_wire(
+        seconds=3.0, host=1, path="host1:*"
+    )
+    path = "memory://wire-slow/run/step_0"
+    before = transport.wire_stats_snapshot()
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        with fl.inject(sched) as ctl:
+            Snapshot.take(path, _state(2.0))
+        assert ctl.fault_counts() == {"slow_wire": 1}
+        after = transport.wire_stats_snapshot()
+        assert after["deadline_misses"] - before["deadline_misses"] == 1
+        hottier.drain_now()
+    report = _read_report(path)
+    rep = report["ranks"][0]["tier"]["replication"]
+    assert rep["deadline_misses"] == 1
+    findings = {f.rule: f.severity for f in diagnose_report(report)}
+    assert findings.get("replication-degraded") == "warn"
+
+
+# ------------------------------------------------- real process boundaries
+
+
+def test_spawn_peer_port_file_and_sigkill(_fresh_wire):
+    """The subprocess peer binds via --port-file, answers pings and
+    queries over the wire, and dies by real SIGKILL through
+    tier.kill_host."""
+    proc, addr, peer = spawn_peer(host_id=1, capacity_bytes=1 << 26)
+    assert ":" in addr
+    assert peer.probe()
+    root = "memory://wire-spawn/run/step_0"
+    data = b"d" * 4096
+    assert ht_tier.put_replica(
+        root + "/0/s/w", 1, data, ht_tier.payload_tag(data), root
+    )
+    assert peer.get(root + "/0/s/w").data == data
+    ht_tier.kill_host(1)
+    assert proc.poll() == -9  # a REAL SIGKILL, not a flag flip
+    with pytest.raises(ht_tier.HostLostError):
+        ht_tier.get_replica(root + "/0/s/w", 1)
+
+
+def test_restore_from_peer_after_real_kill(_fresh_wire):
+    """k=3 with two real peer subprocesses; k-1 losses (one real
+    SIGKILL + the local host) leave the take restorable bit-exact from
+    the surviving peer process."""
+    proc1, _, _ = spawn_peer(host_id=1, capacity_bytes=1 << 26)
+    proc2, _, _ = spawn_peer(host_id=2, capacity_bytes=1 << 26)
+    path = "memory://wire-kill/run/step_0"
+    with hottier.hot_tier(rank=0, world=3, k=3, drain="manual"):
+        snap = Snapshot.take(path, _state(13.0))
+        ht_tier.kill_host(1)  # real SIGKILL
+        ht_tier.kill_host(0)  # local host flag — k-1 = 2 losses total
+        assert proc1.poll() == -9
+        target = _target()
+        snap.restore(target)
+        _assert_restored(target, 13.0)
+        rt = hottier.runtime()
+        assert rt.stats_snapshot()["hot_objects"] >= 1
+        assert proc2.poll() is None  # the survivor served it
+        hottier.drain_now()
+
+
+def test_lose_host_aborts_blocked_socket_read(_fresh_wire, monkeypatch):
+    """The lose_host contract: a socket read blocked on a hung peer
+    (SIGSTOP — the process is alive, the socket open, nothing answers)
+    observes the loss promptly when kill_host aborts the host's
+    in-flight connections, instead of hanging out its full deadline."""
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_DEADLINE_S", "30")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S", "60")
+    proc, _, peer = spawn_peer(host_id=1, capacity_bytes=1 << 26)
+    root = "memory://wire-hang/run/step_0"
+    data = b"h" * 4096
+    assert ht_tier.put_replica(
+        root + "/0/s/w", 1, data, ht_tier.payload_tag(data), root
+    )
+    proc.send_signal(signal.SIGSTOP)  # the peer hangs, socket stays open
+    failures = []
+    done = threading.Event()
+
+    def _blocked_get():
+        t0 = time.monotonic()
+        try:
+            ht_tier.get_replica(root + "/0/s/w", 1)
+        except ht_tier.HostLostError:
+            failures.append(time.monotonic() - t0)
+        done.set()
+
+    thread = threading.Thread(target=_blocked_get, daemon=True)
+    thread.start()
+    time.sleep(0.5)  # let the RPC block on the hung peer
+    ht_tier.kill_host(1)  # SIGKILL + in-flight connection abort
+    assert done.wait(timeout=10.0), "blocked read never observed the loss"
+    thread.join(timeout=5.0)
+    assert failures and failures[0] < 10.0  # far below the 30s deadline
+
+
+def _loss_matrix_point(nth):
+    """One host-loss × crash-point matrix cell: a REAL peer subprocess
+    is SIGKILLed at the nth hottier.replicate boundary; the take must
+    either ack honestly (write-through when k cannot be met) and
+    restore bit-exact, with every obligation retired by drain_now."""
+    spawn_peer(host_id=1, capacity_bytes=1 << 26)
+    path = f"memory://wire-matrix/run/step_{nth}"
+    sched = fl.FaultSchedule().lose_host(
+        1, op="hottier.replicate", nth=nth
+    )
+    state = {
+        "a": StateDict(x=jnp.full((512,), 1.0 + nth, dtype=jnp.float32)),
+        "b": StateDict(y=jnp.full((512,), 2.0 + nth, dtype=jnp.float32)),
+    }
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        t0 = time.monotonic()
+        with fl.inject(sched) as ctl:
+            snap = Snapshot.take(path, state)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, f"take hung {elapsed:.1f}s at nth={nth}"
+        assert ctl.fault_counts().get("hostloss") == 1
+        rt = hottier.runtime()
+        stats = rt.stats_snapshot()
+        # Every object acked: either at k replicas or via write-through.
+        assert stats["write_through"] + stats["replicas"] >= 2
+        target = {
+            "a": StateDict(x=jnp.zeros((512,), dtype=jnp.float32)),
+            "b": StateDict(y=jnp.zeros((512,), dtype=jnp.float32)),
+        }
+        snap.restore(target)
+        np.testing.assert_array_equal(
+            np.asarray(target["a"]["x"]), 1.0 + nth
+        )
+        np.testing.assert_array_equal(
+            np.asarray(target["b"]["y"]), 2.0 + nth
+        )
+        hottier.drain_now()
+        assert hottier.wait_drained(timeout_s=30.0)
+    # The committed take is durable: restorable with the tier OFF too.
+    hottier.reset_hot_tier()
+    target2 = {
+        "a": StateDict(x=jnp.zeros((512,), dtype=jnp.float32)),
+        "b": StateDict(y=jnp.zeros((512,), dtype=jnp.float32)),
+    }
+    Snapshot(path).restore(target2)
+    np.testing.assert_array_equal(np.asarray(target2["a"]["x"]), 1.0 + nth)
+    np.testing.assert_array_equal(np.asarray(target2["b"]["y"]), 2.0 + nth)
+
+
+@pytest.mark.parametrize("nth", [1, 2, 3])
+def test_real_sigkill_loss_matrix_stride(_fresh_wire, nth):
+    """Fast stride subset of the host-loss × crash-point matrix across
+    REAL process boundaries (2 payload objects × k=2 = 4 replicate
+    boundaries; the full enumeration runs under -m slow)."""
+    _loss_matrix_point(nth)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nth", [4])
+def test_real_sigkill_loss_matrix_full(_fresh_wire, nth):
+    """The remaining matrix cells (every replicate boundary of the
+    2-object take)."""
+    _loss_matrix_point(nth)
+
+
+# --------------------------------------------------------------- plumbing
+
+
+def test_addrs_env_registers_peers(_fresh_wire, monkeypatch):
+    """TPUSNAPSHOT_HOT_TIER_ADDRS is the production address book:
+    enable_hot_tier registers the named peers and replication crosses
+    the wire with no explicit wiring."""
+    server, _ = start_local_peer(1, register=False)
+    _fresh_wire.append(server)
+    monkeypatch.setenv("TPUSNAPSHOT_HOT_TIER_ADDRS", f"1={server.addr}")
+    path = "memory://wire-addrs/run/step_0"
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        assert hottier.remote_host(1) is not None
+        before = transport.wire_stats_snapshot()
+        Snapshot.take(path, _state(4.0))
+        after = transport.wire_stats_snapshot()
+        assert after["pushes"] - before["pushes"] == 1
+        hottier.drain_now()
+
+
+def test_replication_ledger_field_and_metrics(_fresh_wire):
+    """The per-take ledger digest carries tier.replication with
+    delta_ratio; the five replication counters move."""
+    from torchsnapshot_tpu import telemetry
+    from torchsnapshot_tpu.telemetry import ledger as runledger
+    from torchsnapshot_tpu.telemetry import metrics as m
+
+    _local_peer(_fresh_wire, 1)
+    path = "memory://wire-ledger/run/step_0"
+    c0 = telemetry.counter(m.HOT_TIER_REPLICATION_PUSHES).value
+    b0 = telemetry.counter(m.HOT_TIER_REPLICATION_BYTES).value
+    with hottier.hot_tier(rank=0, world=2, k=2, drain="manual"):
+        Snapshot.take(path, _state(8.0))
+        hottier.drain_now()
+    assert telemetry.counter(m.HOT_TIER_REPLICATION_PUSHES).value == c0 + 1
+    assert telemetry.counter(m.HOT_TIER_REPLICATION_BYTES).value >= b0 + 8192
+    records, _ = runledger.read_records(path)
+    takes = [r for r in records if r.get("kind") == "take"]
+    assert takes, "take digest missing from ledger"
+    rep = (takes[-1].get("tier") or {}).get("replication")
+    assert rep is not None
+    assert rep["pushes"] == 1
+    assert rep["delta_ratio"] is not None

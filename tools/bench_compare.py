@@ -73,6 +73,13 @@ _METRICS: List[Tuple[str, str, str]] = [
     ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
     ("read_fanout.amplification_served", "fanout amplification", "low"),
     ("read_fanout.served_gbps", "fanout GB/s", "high"),
+    # Snapwire (bench wire section): replication across real peer
+    # processes. The unchanged-retake delta ratio (wire bytes /
+    # payload bytes) is THE dedup-on-the-wire certificate — a rise
+    # means delta replication stopped working; the every-step overhead
+    # with acks crossing process boundaries regresses on a rise.
+    ("wire.delta_ratio_unchanged", "wire delta ratio", "low"),
+    ("wire.overhead_pct", "wire every-step ovh %", "low"),
     # Chunk-store dedup + codec section (bench dedup_codec): physical
     # fractions are lower-is-better (dedup saving fewer bytes is THE
     # regression), the effective logical-bytes throughput is
@@ -350,6 +357,32 @@ def _self_test() -> int:
     assert reg and "every-step" in reg[0], f"overhead rise must fail: {reg}"
     _, reg = compare(base, hot, 0.2)
     assert not reg, f"hot-tier keys absent on one side are skipped: {reg}"
+    # Snapwire keys: the unchanged-retake delta ratio and the
+    # across-process-boundary every-step overhead both regress on a
+    # RISE (a positive baseline — a perfect 0.0 ratio is skipped as
+    # non-positive; the bench's own `ok` verdict gates the absolute
+    # < 0.10 contract each run).
+    wired = dict(
+        base, wire={"delta_ratio_unchanged": 0.05, "overhead_pct": 2.0}
+    )
+    _, reg = compare(wired, dict(wired), 0.2)
+    assert not reg, f"identical wire runs must pass: {reg}"
+    worse_delta = dict(
+        wired, wire={"delta_ratio_unchanged": 0.5, "overhead_pct": 2.0}
+    )
+    _, reg = compare(wired, worse_delta, 0.2)
+    assert reg and "wire delta ratio" in reg[0], (
+        f"delta-ratio 10x must fail: {reg}"
+    )
+    worse_wire_ovh = dict(
+        wired, wire={"delta_ratio_unchanged": 0.05, "overhead_pct": 6.0}
+    )
+    _, reg = compare(wired, worse_wire_ovh, 0.2)
+    assert reg and "wire every-step" in reg[0], (
+        f"wire overhead rise must fail: {reg}"
+    )
+    _, reg = compare(base, wired, 0.2)
+    assert not reg, f"wire keys absent on one side are skipped: {reg}"
     # Read-fanout keys (snapserve): amplification is lower-is-better —
     # a creep from ~1x toward per-client backend reads is the
     # regression; aggregate served throughput is higher-is-better.

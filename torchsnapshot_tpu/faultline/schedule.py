@@ -28,7 +28,20 @@ Fault kinds:
 - **host loss** — a hot-tier peer host is preempted at a deterministic
   op boundary (its RAM replicas vanish; ``hottier.kill_host``); the op
   stream continues and the loss surfaces wherever the tier next touches
-  the dead host.
+  the dead host. For a host backed by a REAL snapwire peer process,
+  ``kill_host`` SIGKILLs the process and aborts its in-flight transport
+  connections, so a blocked socket read observes the loss within the
+  RPC deadline instead of hanging until timeout.
+- **wire faults** (``drop_conn`` / ``torn_frame`` / ``slow_wire``) —
+  the snapwire replication transport's failure modes, armed at a
+  deterministic ``hottier.replicate`` boundary and consumed by the next
+  matching RPC: a *dropped connection* aborts the socket before the
+  request leaves, a *torn frame* sends a truncated frame then aborts
+  (the receiver never acks — ack-at-k is backed by verified bytes or
+  not given), and a *slow wire* sleeps the RPC into its
+  ``TPUSNAPSHOT_REPLICATION_DEADLINE_S`` deadline. All three surface
+  as transport failures and exercise the retry → spare-host →
+  write-through degradation ladder.
 - **server kill** — every in-process snapserve read-service dies at a
   deterministic ``snapserve.request`` boundary
   (``snapserve.kill_local_servers``): sockets abort, the listening
@@ -101,6 +114,7 @@ class FaultRule:
 
     kind: str  # "transient" | "permanent" | "torn" | "latency" | "crash"
     #          | "hostloss" | "killserver"
+    #          | "drop_conn" | "torn_frame" | "slow_wire"  (snapwire)
     op: str = "*"
     path: str = "*"
     nth: int = 1
@@ -281,6 +295,74 @@ class FaultSchedule:
             times=times,
         )
 
+    def drop_conn(
+        self,
+        host: Optional[int] = None,
+        op: str = "hottier.replicate",
+        path: str = "*",
+        nth: int = 1,
+        times: Optional[int] = 1,
+    ) -> "FaultSchedule":
+        """Snapwire: the connection to peer ``host`` (None = any peer)
+        dies at the ``nth`` matching op boundary — the next RPC to that
+        host aborts its socket before the request leaves and fails as a
+        transport error. The retry layer (jitter under
+        ``TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S``) absorbs it by
+        re-dialing; the schedule is deterministic because the fault is
+        armed at the op boundary, not on a timer."""
+        self.rules.append(
+            FaultRule(
+                kind="drop_conn", op=op, path=path, nth=nth, times=times,
+                host=host,
+            )
+        )
+        return self
+
+    def torn_frame(
+        self,
+        host: Optional[int] = None,
+        op: str = "hottier.replicate",
+        path: str = "*",
+        nth: int = 1,
+        times: Optional[int] = 1,
+    ) -> "FaultSchedule":
+        """Snapwire: the next matching RPC to peer ``host`` sends only
+        HALF its frame and then aborts the connection — the receiver's
+        ``readexactly`` observes the tear and never acks (a torn frame
+        can only produce a NACK; the ack-at-k contract is backed by
+        verified bytes or not given). The client sees a transport
+        failure and retries/degrades."""
+        self.rules.append(
+            FaultRule(
+                kind="torn_frame", op=op, path=path, nth=nth, times=times,
+                host=host,
+            )
+        )
+        return self
+
+    def slow_wire(
+        self,
+        seconds: float = 0.05,
+        host: Optional[int] = None,
+        op: str = "hottier.replicate",
+        path: str = "*",
+        nth: int = 1,
+        times: Optional[int] = 1,
+    ) -> "FaultSchedule":
+        """Snapwire: the next matching RPC to peer ``host`` pays
+        ``seconds`` on the wire before the request is sent — with
+        ``seconds`` above ``TPUSNAPSHOT_REPLICATION_DEADLINE_S`` the
+        RPC deterministically misses its deadline (counted in
+        ``tpusnapshot_hot_tier_replication_deadline_misses_total``) and
+        enters the retry → spare-host → write-through ladder."""
+        self.rules.append(
+            FaultRule(
+                kind="slow_wire", op=op, path=path, nth=nth, times=times,
+                seconds=seconds, host=host,
+            )
+        )
+        return self
+
     def crash_at(self, op_index: int) -> "FaultSchedule":
         """Crash at global op index ``op_index`` (1-based) and every
         boundary after it — the crash-point enumerator's lever."""
@@ -383,6 +465,19 @@ class FaultController:
                     from ..hottier import kill_host
 
                     kill_host(rule.host)
+                    continue
+                if rule.kind in ("drop_conn", "torn_frame", "slow_wire"):
+                    self._record(idx, op, path, rule.kind)
+                    from ..hottier import transport
+
+                    # Arm the wire fault; the next RPC to the matched
+                    # host consumes it (for the canonical
+                    # hottier.replicate boundary that IS the RPC this
+                    # boundary guards — the emit fires just before the
+                    # put dials).
+                    transport.script_wire_fault(
+                        rule.kind, host=rule.host, seconds=rule.seconds
+                    )
                     continue
                 if rule.kind == "killserver":
                     self._record(idx, op, path, "killserver")

@@ -42,6 +42,8 @@ from .runtime import (
     is_enabled,
     is_payload_path,
     reconcile_hot_tier,
+    replication_stats_begin,
+    replication_stats_collect,
     reset_pending,
     restore_stats_begin,
     restore_stats_collect,
@@ -53,10 +55,15 @@ from .tier import (
     buffered_roots,
     kill_host,
     live_hosts,
+    register_remote_host,
+    remote_host,
+    remote_hosts,
     reset_hot_tier,
     revive_host,
     total_buffered_bytes,
+    unregister_remote_host,
 )
+from . import peer, transport  # noqa: F401  (snapwire submodules)
 
 __all__ = [
     "BYTES_ENV_VAR",
@@ -77,7 +84,13 @@ __all__ = [
     "is_payload_path",
     "kill_host",
     "live_hosts",
+    "peer",
     "reconcile_hot_tier",
+    "register_remote_host",
+    "remote_host",
+    "replication_stats_begin",
+    "replication_stats_collect",
+    "remote_hosts",
     "reset_hot_tier",
     "reset_pending",
     "restore_stats_begin",
@@ -85,5 +98,7 @@ __all__ = [
     "revive_host",
     "runtime",
     "total_buffered_bytes",
+    "transport",
+    "unregister_remote_host",
     "wait_drained",
 ]

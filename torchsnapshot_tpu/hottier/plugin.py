@@ -69,6 +69,14 @@ class TieredPlugin(StoragePlugin):
                 rt.on_commit(self._root)
             return
         payload = bytes(io_payload(io_req))
+        # hot_put runs INLINE on the event loop, wire RPCs included —
+        # deliberately: serializing the hottier.replicate boundaries is
+        # what keeps faultline's crash-point op stream deterministic
+        # (concurrent executor-thread puts would interleave op indices
+        # across replays), and the span inherits the take's ambient
+        # trace. The cost is bounded by the per-RPC deadline + retry
+        # budget per peer, after which the down-cooldown makes every
+        # later push to that peer fail fast.
         placed, tag = rt.hot_put(self._root, io_req.path, payload)
         # The ack moment: hot_put returned — from here the object's
         # durability-lag clock runs (ack → drained, per object), fed to
@@ -101,6 +109,7 @@ class TieredPlugin(StoragePlugin):
                 self._root, io_req.path, tag, placed, nbytes=len(payload)
             )
             return
+        rt.note_replicated_ack(len(payload))
         rt.enqueue_drain(
             self._root,
             io_req.path,

@@ -230,6 +230,8 @@ class HotTierRuntime:
             "fallback_bytes": 0,
             "replicas": 0,
             "write_through": 0,
+            "write_through_bytes": 0,
+            "replicated_ack_bytes": 0,
             "degraded_puts": 0,
             "drained_objects": 0,
             "drained_bytes": 0,
@@ -398,6 +400,11 @@ class HotTierRuntime:
         now = time.monotonic()
         with self._cond:
             self._stats["write_through"] += 1
+            if nbytes is not None:
+                # Acked-bytes attribution for the replication-degraded
+                # doctor rule: bytes whose pre-ack durability came from
+                # the synchronous write-through path, not k replicas.
+                self._stats["write_through_bytes"] += nbytes
             if degraded:
                 self._stats["degraded_puts"] += 1
             self._forgotten.discard(root)
@@ -602,6 +609,84 @@ class HotTierRuntime:
             _metric_names.HOT_TIER_READS, tier="durable"
         ).inc()
         return None, True
+
+    def note_replicated_ack(self, nbytes: int) -> None:
+        """The object was acked AT k replicas (no write-through): the
+        other half of the acked-bytes attribution the
+        replication-degraded doctor rule splits."""
+        with self._lock:
+            self._stats["replicated_ack_bytes"] += nbytes
+
+    def replication_stats_begin(self) -> Dict[str, Any]:
+        """Token for per-take replication attribution: a snapshot of
+        the runtime's put-side stats plus the snapwire transport's
+        process totals (transport.wire_stats_snapshot)."""
+        from . import transport
+
+        return {
+            "rt": self.stats_snapshot(),
+            "wire": transport.wire_stats_snapshot(),
+        }
+
+    def replication_stats_collect(
+        self, token: Optional[Dict[str, Any]]
+    ) -> Optional[Dict[str, Any]]:
+        """The ``tier.replication`` block for the operation since
+        ``token``: pushes/bytes/wire-bytes (and their ratio —
+        ``delta_ratio``, the certified unchanged-retake number),
+        retries, deadline misses, and the acked-bytes split between
+        k-replication and the degraded write-through path. None when
+        the window saw no wire traffic at all (the in-process tier's
+        behavior is unchanged — this block exists for real
+        transports)."""
+        from . import transport
+
+        if token is None:
+            return None
+        rt_now = self.stats_snapshot()
+        wire_now = transport.wire_stats_snapshot()
+
+        def _dw(field: str) -> int:
+            return int(wire_now.get(field, 0)) - int(
+                (token.get("wire") or {}).get(field, 0)
+            )
+
+        def _dr(field: str) -> int:
+            return int(rt_now.get(field, 0)) - int(
+                (token.get("rt") or {}).get(field, 0)
+            )
+
+        pushes = _dw("pushes")
+        push_failures = _dw("push_failures")
+        retries = _dw("retries")
+        deadline_misses = _dw("deadline_misses")
+        if (
+            pushes <= 0
+            and push_failures <= 0
+            and retries <= 0
+            and deadline_misses <= 0
+        ):
+            return None
+        payload_bytes = _dw("payload_bytes")
+        wire_bytes = _dw("wire_bytes")
+        block: Dict[str, Any] = {
+            "pushes": pushes,
+            "push_failures": push_failures,
+            "payload_bytes": payload_bytes,
+            "wire_bytes": wire_bytes,
+            "delta_ratio": (
+                round(wire_bytes / payload_bytes, 4)
+                if payload_bytes > 0
+                else None
+            ),
+            "retries": retries,
+            "deadline_misses": deadline_misses,
+            "replicated_ack_bytes": _dr("replicated_ack_bytes"),
+            "write_through_objects": _dr("write_through"),
+            "write_through_bytes": _dr("write_through_bytes"),
+            "degraded_puts": _dr("degraded_puts"),
+        }
+        return block
 
     def note_fallback_bytes(self, nbytes: int) -> None:
         with self._lock:
@@ -1566,6 +1651,12 @@ def enable_hot_tier(
 
         _PREV_HOOK = _sp.set_plugin_wrap_hook(_hook)
         _RUNTIME = rt
+        # Multi-host address book: TPUSNAPSHOT_HOT_TIER_ADDRS registers
+        # real peer processes ("1=host:port,2=host:port") so replica
+        # hosts named there are reached over the snapwire transport.
+        from . import transport as _transport
+
+        _transport.register_peers_from_env()
         return rt
 
 
@@ -1714,6 +1805,28 @@ def reconcile_hot_tier(
         forget_root(root)
         dropped.append(root)
     return dropped
+
+
+def replication_stats_begin() -> Optional[Dict[str, Any]]:
+    """Token for per-take replication attribution (None = tier off)."""
+    rt = _RUNTIME
+    return (
+        rt.replication_stats_begin()
+        if rt is not None and rt.active
+        else None
+    )
+
+
+def replication_stats_collect(
+    token: Optional[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """The take's ``tier.replication`` flight-report block since
+    ``token`` (see :meth:`HotTierRuntime.replication_stats_collect`);
+    None when the tier is off or the window had no wire traffic."""
+    rt = _RUNTIME
+    if token is None or rt is None:
+        return None
+    return rt.replication_stats_collect(token)
 
 
 def restore_stats_begin() -> Optional[Dict[str, Any]]:

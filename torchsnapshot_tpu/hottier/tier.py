@@ -84,7 +84,90 @@ class HostRamStore:
 _TIER_LOCK = threading.RLock()
 _HOSTS: Dict[int, HostRamStore] = {}
 # Rendezvous index: key -> hosts holding a replica (in placement order).
+# Remote placements are indexed here too, so every key lookup answers
+# from one structure regardless of where the replica physically lives.
 _KEY_HOSTS: Dict[str, List[int]] = {}
+
+# ----------------------------------------------------------- remote hosts
+#
+# snapwire (transport.py / peer.py): a host id registered here is backed
+# by a REAL peer process over TCP instead of an in-process dict. The
+# registry lives in this module so every tier function can route without
+# an import cycle; the registered object is duck-typed (RemotePeer).
+# _REMOTE_SHADOW is the client-side ledger of what we placed on each
+# remote host — (host_id, key) -> {root, nbytes, tag (the logical
+# content tag hot_put computed), stored_tag (what the peer actually
+# holds; differs only for lossy int8 pushes), put_t, drained} — feeding
+# the same accounting (buffered_roots / occupancy / ages / key_tag) the
+# local stores answer from their own dicts, without a per-query RPC.
+
+_REMOTE: Dict[int, object] = {}
+_REMOTE_SHADOW: Dict[tuple, Dict[str, object]] = {}
+
+# Peer-SERVER scope (peer.py): when an in-process peer serves a host id
+# this same process also has registered as remote, the server half must
+# address the LOCAL store — otherwise its tier calls would route back
+# through the RemotePeer into itself. Thread-local because the server
+# handles requests on its own event-loop thread.
+_LOCAL_ONLY = threading.local()
+
+
+class serve_local:
+    """``with tier.serve_local():`` — tier calls on this thread address
+    local stores even for remotely-registered host ids (the peer-server
+    side of an in-process wire)."""
+
+    def __enter__(self) -> None:
+        _LOCAL_ONLY.active = True
+
+    def __exit__(self, *exc) -> None:
+        _LOCAL_ONLY.active = False
+
+
+def _route_peer(host_id: int):
+    """The remote peer to route ``host_id`` through, or None for the
+    local store (unregistered host, or inside a :class:`serve_local`
+    scope)."""
+    if getattr(_LOCAL_ONLY, "active", False):
+        return None
+    return remote_host(host_id)
+
+
+def register_remote_host(host_id: int, peer) -> None:
+    """Back virtual host ``host_id`` with a remote peer client
+    (transport.RemotePeer): every tier operation addressing it crosses
+    the wire from here on."""
+    with _TIER_LOCK:
+        if host_id in _HOSTS and _HOSTS[host_id].objects:
+            raise RuntimeError(
+                f"host {host_id} already holds in-process replicas; "
+                f"cannot re-register it as remote"
+            )
+        _HOSTS.pop(host_id, None)
+        _REMOTE[host_id] = peer
+
+
+def unregister_remote_host(host_id: int, kill_spawned: bool = True) -> None:
+    with _TIER_LOCK:
+        peer = _REMOTE.pop(host_id, None)
+        for hk in [k for k in _REMOTE_SHADOW if k[0] == host_id]:
+            del _REMOTE_SHADOW[hk]
+    if peer is not None:
+        try:
+            peer.close(kill_spawned=kill_spawned)
+        except Exception as e:
+            logger.warning(f"remote peer close failed: {e!r}")
+
+
+def remote_host(host_id: int):
+    """The registered remote peer for ``host_id`` (None = in-process)."""
+    with _TIER_LOCK:
+        return _REMOTE.get(host_id)
+
+
+def remote_hosts() -> Dict[int, object]:
+    with _TIER_LOCK:
+        return dict(_REMOTE)
 
 
 def host_store(host_id: int, capacity_bytes: Optional[int] = None) -> HostRamStore:
@@ -106,7 +189,26 @@ def kill_host(host_id: int) -> None:
 
     Index entries are NOT cleaned — a reader discovers the death on
     access (the ``dead`` fallback reason), exactly like a real
-    unreachable peer."""
+    unreachable peer.
+
+    For a host backed by a REAL remote peer (snapwire), this is real:
+    a spawned peer subprocess is SIGKILLed, and the host's in-flight
+    transport connections are aborted so a blocked socket read observes
+    the loss within the RPC deadline instead of hanging until timeout
+    (the ``lose_host`` contract)."""
+    peer = remote_host(host_id)
+    if peer is not None:
+        peer.kill()
+        with _TIER_LOCK:
+            # The dead process's RAM is gone: clear the client-side
+            # shadow so buffered_roots/occupancy stop counting vanished
+            # replicas (the local branch's objects.clear() analog).
+            # Index entries stay, exactly like the local branch —
+            # readers discover the death on access.
+            for hk in [k for k in _REMOTE_SHADOW if k[0] == host_id]:
+                del _REMOTE_SHADOW[hk]
+            _update_buffered_gauge()
+        return
     with _TIER_LOCK:
         store = host_store(host_id)
         store.alive = False
@@ -116,28 +218,57 @@ def kill_host(host_id: int) -> None:
 
 
 def revive_host(host_id: int) -> None:
-    """Bring a host back (empty — preemption lost its RAM)."""
+    """Bring a host back (empty — preemption lost its RAM). Remote
+    peers do not revive: a preempted host comes back as a NEW process
+    (spawn + register again)."""
+    if remote_host(host_id) is not None:
+        logger.warning(
+            f"revive_host({host_id}): remote peers do not revive; spawn "
+            f"and register a new peer process instead"
+        )
+        return
     with _TIER_LOCK:
         host_store(host_id).alive = True
 
 
 def live_hosts() -> List[int]:
     with _TIER_LOCK:
-        return sorted(h for h, s in _HOSTS.items() if s.alive)
+        hosts = {h for h, s in _HOSTS.items() if s.alive}
+        hosts.update(h for h, p in _REMOTE.items() if p.alive)
+        return sorted(hosts)
 
 
 def reset_hot_tier() -> None:
-    """Drop every host, object, and index entry (tests)."""
+    """Drop every host, object, index entry, and remote peer
+    registration (tests). Spawned peer subprocesses are killed so no
+    test leaks a process."""
     with _TIER_LOCK:
+        peers = list(_REMOTE.values())
+        _REMOTE.clear()
+        _REMOTE_SHADOW.clear()
         _HOSTS.clear()
         _KEY_HOSTS.clear()
         _update_buffered_gauge()
+    for peer in peers:
+        try:
+            peer.close(kill_spawned=True)
+        except Exception as e:
+            logger.warning(f"remote peer close failed: {e!r}")
 
 
 def _update_buffered_gauge() -> None:
-    # Lock held by caller.
+    # Lock held by caller. The client view: local stores of in-process
+    # hosts plus the shadow of remote placements (a remote host's local
+    # store — the in-process peer-server half — would double-count).
     telemetry.gauge(_metric_names.HOT_TIER_BUFFERED_BYTES).set(
-        float(sum(s.used_bytes for s in _HOSTS.values()))
+        float(
+            sum(
+                s.used_bytes
+                for h, s in _HOSTS.items()
+                if h not in _REMOTE
+            )
+            + sum(int(s["nbytes"]) for s in _REMOTE_SHADOW.values())
+        )
     )
 
 
@@ -178,6 +309,32 @@ def put_replica(
     capacity. Raises :class:`HostLostError` on a dead host. Replaces any
     existing replica of ``key`` (a re-written object invalidates the old
     bytes — stale replicas cannot survive a successful re-put)."""
+    peer = _route_peer(host_id)
+    if peer is not None:
+        # Over the wire (no tier lock held during the RPC): the peer
+        # reconstructs the delta push, fingerprint-verifies, stores, and
+        # only then acks — `stored` False is a capacity refusal. A dead
+        # or down peer raises HostLostError from inside put (counted as
+        # a push failure in the wire stats).
+        stored, stored_tag = peer.put(
+            key, bytes(data), tag, root, capacity_bytes=capacity_bytes
+        )
+        with _TIER_LOCK:
+            if stored:
+                _REMOTE_SHADOW[(host_id, key)] = {
+                    "root": root.rstrip("/"),
+                    "nbytes": len(data),
+                    "tag": tag,
+                    "stored_tag": stored_tag,
+                    "put_t": time.time(),
+                    "drained": False,
+                }
+                hosts = _KEY_HOSTS.setdefault(key, [])
+                if host_id not in hosts:
+                    hosts.append(host_id)
+                telemetry.counter(_metric_names.HOT_TIER_REPLICAS).inc()
+                _update_buffered_gauge()
+        return stored
     with _TIER_LOCK:
         store = host_store(host_id, capacity_bytes)
         if not store.alive:
@@ -206,6 +363,11 @@ def get_replica(key: str, host_id: int) -> HotObject:
     """The replica on ``host_id`` — raises :class:`HostLostError` (dead
     host) or ``KeyError`` (missing). Verifying the content tag is the
     CALLER's job (the runtime counts corruption as a fallback reason)."""
+    peer = _route_peer(host_id)
+    if peer is not None:
+        if not peer.alive:
+            raise HostLostError(f"host {host_id} is dead")
+        return peer.get(key)  # KeyError / HostLostError propagate
     with _TIER_LOCK:
         store = _HOSTS.get(host_id)
         if store is None or not store.alive:
@@ -223,8 +385,27 @@ def replica_hosts_for(key: str) -> Optional[List[int]]:
         return list(hosts) if hosts is not None else None
 
 
+def _remote_quiet(peer, op: str, *args) -> None:
+    """Best-effort remote side-effect: a dead/unreachable peer already
+    IS the state we wanted (its replicas are gone with it)."""
+    try:
+        getattr(peer, op)(*args)
+    except (HostLostError, KeyError):
+        pass
+    except Exception as e:
+        logger.warning(f"remote {op} failed: {e!r}")
+
+
 def drop_replica(key: str, host_id: int) -> None:
     """Remove one (e.g. corrupt) replica."""
+    peer = _route_peer(host_id)
+    if peer is not None:
+        _remote_quiet(peer, "drop", key)
+        with _TIER_LOCK:
+            _REMOTE_SHADOW.pop((host_id, key), None)
+            _index_remove(key, host_id)
+            _update_buffered_gauge()
+        return
     with _TIER_LOCK:
         store = _HOSTS.get(host_id)
         if store is not None:
@@ -237,10 +418,17 @@ def drop_replica(key: str, host_id: int) -> None:
 
 def forget_key(key: str) -> bool:
     """Drop every replica of ``key``; True if any existed."""
+    remote_peers = []
     with _TIER_LOCK:
         hosts = _KEY_HOSTS.pop(key, None)
         existed = False
         for h in hosts or []:
+            peer = _route_peer(h)
+            if peer is not None:
+                if _REMOTE_SHADOW.pop((h, key), None) is not None:
+                    existed = True
+                remote_peers.append(peer)
+                continue
             store = _HOSTS.get(h)
             if store is None:
                 continue
@@ -249,21 +437,38 @@ def forget_key(key: str) -> bool:
                 store.used_bytes -= len(obj.data)
                 existed = True
         _update_buffered_gauge()
-        return existed
+    for peer in remote_peers:  # RPCs outside the tier lock
+        _remote_quiet(peer, "drop", key)
+    return existed
 
 
 def mark_drained(key: str, tag: Optional[str] = None) -> None:
     """Flag replicas of ``key`` as persisted (hence evictable). With
     ``tag``, only replicas holding exactly those bytes are flagged — a
     replica of a NEWER re-write of the object is not durable just
-    because an older version of it reached storage."""
+    because an older version of it reached storage. A remote replica is
+    flagged by its STORED tag (a lossy push's stored bytes differ from
+    the logical object, but the logical object they derive from is
+    durable — they are equally evictable)."""
+    remote_ops = []
     with _TIER_LOCK:
         for h in _KEY_HOSTS.get(key, []):
+            peer = _route_peer(h)
+            if peer is not None:
+                shadow = _REMOTE_SHADOW.get((h, key))
+                if shadow is not None and (
+                    tag is None or shadow["tag"] == tag
+                ):
+                    shadow["drained"] = True
+                    remote_ops.append((peer, shadow["stored_tag"]))
+                continue
             store = _HOSTS.get(h)
             if store is not None:
                 obj = store.objects.get(key)
                 if obj is not None and (tag is None or obj.tag == tag):
                     obj.drained = True
+    for peer, stored_tag in remote_ops:  # RPCs outside the tier lock
+        _remote_quiet(peer, "mark_drained", key, stored_tag)
 
 
 def drop_stale_replicas(key: str, tag: str) -> None:
@@ -271,9 +476,21 @@ def drop_stale_replicas(key: str, tag: str) -> None:
     — superseded bytes left on hosts outside the newest placement when
     the replica set changed between writes. They must not linger: a
     self-consistent stale replica would serve old bytes to readers,
-    and being undrained it would pin host RAM forever."""
+    and being undrained it would pin host RAM forever. Remote staleness
+    is judged against the client-side shadow's LOGICAL tag (a lossy
+    push stores different bytes under the same logical tag and is not
+    stale)."""
+    remote_peers = []
     with _TIER_LOCK:
         for h in list(_KEY_HOSTS.get(key, [])):
+            peer = _route_peer(h)
+            if peer is not None:
+                shadow = _REMOTE_SHADOW.get((h, key))
+                if shadow is not None and shadow["tag"] != tag:
+                    del _REMOTE_SHADOW[(h, key)]
+                    _index_remove(key, h)
+                    remote_peers.append(peer)
+                continue
             store = _HOSTS.get(h)
             obj = store.objects.get(key) if store is not None else None
             if obj is not None and obj.tag != tag:
@@ -281,6 +498,8 @@ def drop_stale_replicas(key: str, tag: str) -> None:
                 store.used_bytes -= len(obj.data)
                 _index_remove(key, h)
         _update_buffered_gauge()
+    for peer in remote_peers:  # RPCs outside the tier lock
+        _remote_quiet(peer, "drop", key)
 
 
 def key_tag(key: str) -> Optional[str]:
@@ -288,6 +507,9 @@ def key_tag(key: str) -> Optional[str]:
     replica survives)."""
     with _TIER_LOCK:
         for h in _KEY_HOSTS.get(key, []):
+            shadow = _REMOTE_SHADOW.get((h, key))
+            if shadow is not None:
+                return shadow["tag"]
             store = _HOSTS.get(h)
             obj = store.objects.get(key) if store is not None else None
             if obj is not None:
@@ -302,16 +524,26 @@ def key_age_s(key: str) -> Optional[float]:
     with _TIER_LOCK:
         newest: Optional[float] = None
         for h in _KEY_HOSTS.get(key, []):
-            store = _HOSTS.get(h)
-            obj = store.objects.get(key) if store is not None else None
-            if obj is not None and (newest is None or obj.put_t > newest):
-                newest = obj.put_t
+            shadow = _REMOTE_SHADOW.get((h, key))
+            put_t: Optional[float] = None
+            if shadow is not None:
+                put_t = float(shadow["put_t"])
+            else:
+                store = _HOSTS.get(h)
+                obj = store.objects.get(key) if store is not None else None
+                if obj is not None:
+                    put_t = obj.put_t
+            if put_t is not None and (newest is None or put_t > newest):
+                newest = put_t
         return None if newest is None else max(0.0, time.time() - newest)
 
 
 def key_size_bytes(key: str) -> Optional[int]:
     with _TIER_LOCK:
         for h in _KEY_HOSTS.get(key, []):
+            shadow = _REMOTE_SHADOW.get((h, key))
+            if shadow is not None:
+                return int(shadow["nbytes"])
             store = _HOSTS.get(h)
             obj = store.objects.get(key) if store is not None else None
             if obj is not None:
@@ -322,12 +554,22 @@ def key_size_bytes(key: str) -> Optional[int]:
 def buffered_roots() -> Dict[str, int]:
     """``{snapshot_root: buffered_bytes}`` across all hosts — the
     accounting the leak checks and reconcile sweeps fold over. Bytes are
-    summed over replicas (k copies of a root count k times)."""
+    summed over replicas (k copies of a root count k times). Remote
+    replicas count from the client-side shadow; an in-process peer
+    server's local store for a remotely-registered host is the SERVER
+    half of the same replicas and is excluded (it would double-count)."""
+    local_scope = getattr(_LOCAL_ONLY, "active", False)
     with _TIER_LOCK:
         out: Dict[str, int] = {}
-        for store in _HOSTS.values():
+        for host_id, store in _HOSTS.items():
+            if not local_scope and host_id in _REMOTE:
+                continue
             for obj in store.objects.values():
                 out[obj.root] = out.get(obj.root, 0) + len(obj.data)
+        if not local_scope:
+            for shadow in _REMOTE_SHADOW.values():
+                root = str(shadow["root"])
+                out[root] = out.get(root, 0) + int(shadow["nbytes"])
         return out
 
 
@@ -350,8 +592,15 @@ def keys_for_root(root: str) -> List[str]:
 
 
 def total_buffered_bytes() -> int:
+    local_scope = getattr(_LOCAL_ONLY, "active", False)
     with _TIER_LOCK:
-        return sum(s.used_bytes for s in _HOSTS.values())
+        if local_scope:
+            return sum(s.used_bytes for s in _HOSTS.values())
+        return sum(
+            s.used_bytes
+            for h, s in _HOSTS.items()
+            if h not in _REMOTE
+        ) + sum(int(s["nbytes"]) for s in _REMOTE_SHADOW.values())
 
 
 def host_occupancy() -> Dict[int, Dict[str, object]]:
@@ -360,9 +609,10 @@ def host_occupancy() -> Dict[int, Dict[str, object]]:
     the bytes that are pinned (unevictable) because the durable tier
     does not hold them yet. One pass under the tier lock, so the view
     is self-consistent."""
+    local_scope = getattr(_LOCAL_ONLY, "active", False)
     with _TIER_LOCK:
         out: Dict[int, Dict[str, object]] = {}
-        for host_id, store in sorted(_HOSTS.items()):
+        for host_id, store in _HOSTS.items():
             undrained = sum(
                 len(o.data) for o in store.objects.values() if not o.drained
             )
@@ -373,4 +623,20 @@ def host_occupancy() -> Dict[int, Dict[str, object]]:
                 "objects": len(store.objects),
                 "undrained_bytes": undrained,
             }
-        return out
+        for host_id, peer in [] if local_scope else _REMOTE.items():
+            entries = [
+                s for (h, _k), s in _REMOTE_SHADOW.items() if h == host_id
+            ]
+            out[host_id] = {
+                "alive": peer.alive,
+                "used_bytes": sum(int(s["nbytes"]) for s in entries),
+                "capacity_bytes": int(
+                    getattr(peer, "capacity_bytes", 0) or 0
+                ),
+                "objects": len(entries),
+                "undrained_bytes": sum(
+                    int(s["nbytes"]) for s in entries if not s["drained"]
+                ),
+                "remote": True,
+            }
+        return dict(sorted(out.items()))

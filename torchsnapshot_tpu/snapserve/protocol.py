@@ -1,11 +1,11 @@
 """snapserve wire protocol: length-prefixed JSON header + raw payload.
 
-One frame both ways::
-
-    !I  header length        (JSON, utf-8, <= MAX_HEADER_BYTES)
-    !Q  payload length       (raw bytes, <= MAX_PAYLOAD_BYTES)
-    header bytes
-    payload bytes
+The framing and error marshalling live in the shared
+:mod:`torchsnapshot_tpu.wire` module (one implementation for every TCP
+service in the tree — this read plane and the hot tier's snapwire
+replication transport); this module re-exports it under the historical
+names so snapserve code and external callers are unchanged. Frames are
+bit-compatible with the pre-extraction protocol.
 
 Request headers: ``{"v": 1, "op": ..., "backend": ..., "path": ...,
 "range": [start, end] | null, "trace": {"id", "flow"} | absent}``.
@@ -30,99 +30,30 @@ the service and against the backend directly — the bit-exact-fallback
 contract depends on that equivalence.
 """
 
-import asyncio
-import json
-import struct
-from typing import Any, Dict, Optional, Tuple
+from ..wire import (  # noqa: F401  (re-exported protocol surface)
+    MAX_HEADER_BYTES,
+    MAX_PAYLOAD_BYTES,
+    PROTOCOL_VERSION,
+    InvalidRange,
+    ProtocolError,
+    RemoteServerError,
+    encode_frame,
+    error_to_wire,
+    recv_frame,
+    send_frame,
+    wire_to_error,
+)
 
-PROTOCOL_VERSION = 1
-MAX_HEADER_BYTES = 1 << 20
-# Payloads are whole checkpoint objects; the sharded write path caps
-# objects at 512 MiB but dense single-device leaves are unbounded —
-# allow large frames and let the server's cache policy bound memory.
-MAX_PAYLOAD_BYTES = 1 << 40
-
-_HEADER_STRUCT = struct.Struct("!IQ")
-
-
-class ProtocolError(Exception):
-    """Malformed frame — the connection cannot be trusted afterwards."""
-
-
-class RemoteServerError(Exception):
-    """The server reached its backend and the backend failed. Carries
-    the remote error's repr; treated like any other storage failure by
-    the retry layer above the client plugin."""
-
-
-class InvalidRange(Exception):
-    """Server-side range-not-satisfiable, re-raised client-side. The
-    class NAME is the contract: ``io_types.is_range_not_satisfiable_error``
-    classifies structurally by ``__name__`` over the MRO."""
-
-
-async def send_frame(
-    writer: asyncio.StreamWriter,
-    header: Dict[str, Any],
-    payload: bytes = b"",
-) -> None:
-    raw = json.dumps(header, sort_keys=True).encode("utf-8")
-    if len(raw) > MAX_HEADER_BYTES:
-        raise ProtocolError(f"header too large: {len(raw)} bytes")
-    writer.write(_HEADER_STRUCT.pack(len(raw), len(payload)))
-    writer.write(raw)
-    if payload:
-        writer.write(payload)
-    await writer.drain()
-
-
-async def recv_frame(
-    reader: asyncio.StreamReader,
-) -> Tuple[Dict[str, Any], bytes]:
-    """Read one frame; raises ``asyncio.IncompleteReadError`` on a
-    cleanly closed peer (callers treat that as end-of-stream) and
-    :class:`ProtocolError` on garbage."""
-    head = await reader.readexactly(_HEADER_STRUCT.size)
-    header_len, payload_len = _HEADER_STRUCT.unpack(head)
-    if header_len > MAX_HEADER_BYTES:
-        raise ProtocolError(f"header length {header_len} exceeds limit")
-    if payload_len > MAX_PAYLOAD_BYTES:
-        raise ProtocolError(f"payload length {payload_len} exceeds limit")
-    raw = await reader.readexactly(header_len)
-    try:
-        header = json.loads(raw.decode("utf-8"))
-    except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise ProtocolError(f"unparseable frame header: {e!r}") from e
-    if not isinstance(header, dict):
-        raise ProtocolError(f"frame header is not an object: {header!r}")
-    payload = await reader.readexactly(payload_len) if payload_len else b""
-    return header, payload
-
-
-def error_to_wire(exc: BaseException) -> Dict[str, str]:
-    """Classify a server-side failure into the wire taxonomy using the
-    same structural classifiers the retry layer uses."""
-    from ..io_types import is_not_found_error, is_range_not_satisfiable_error
-
-    if is_not_found_error(exc):
-        kind = "not_found"
-    elif is_range_not_satisfiable_error(exc):
-        kind = "range"
-    else:
-        kind = "backend"
-    return {"kind": kind, "message": repr(exc)}
-
-
-def wire_to_error(
-    error: Optional[Dict[str, Any]], path: str
-) -> Exception:
-    """The client-side exception for a wire error dict."""
-    kind = (error or {}).get("kind")
-    message = (error or {}).get("message", "")
-    if kind == "not_found":
-        return FileNotFoundError(path)
-    if kind == "range":
-        return InvalidRange(f"{path}: {message}")
-    if kind == "bad_request":
-        return ProtocolError(f"{path}: {message}")
-    return RemoteServerError(f"{path}: {message}")
+__all__ = [
+    "MAX_HEADER_BYTES",
+    "MAX_PAYLOAD_BYTES",
+    "PROTOCOL_VERSION",
+    "InvalidRange",
+    "ProtocolError",
+    "RemoteServerError",
+    "encode_frame",
+    "error_to_wire",
+    "recv_frame",
+    "send_frame",
+    "wire_to_error",
+]

@@ -38,6 +38,14 @@ above-budget              TPUSNAPSHOT_CKPT_BUDGET_PCT (default 5%)
 missing-rank-summary      a rank's summary never arrived (null)
 hot-tier-degraded         a restore fell back to the durable tier for
                           >0 objects (critical when >50% of bytes)
+replication-degraded      a take's snapwire replication missed a
+                          per-RPC deadline or failed a push (warn);
+                          critical when those wire failures pushed
+                          >50% of the acked bytes onto the synchronous
+                          write-through path — acks stay honest but
+                          pay storage latency. Capacity-caused
+                          write-throughs without wire failures do not
+                          fire it
 read-plane-degraded       a restore routed via snapserve fell back to
                           direct backend reads for >0 objects
                           (critical when >50% of bytes) — the read
@@ -669,6 +677,77 @@ def _rule_hot_tier_degraded(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_replication_degraded(report: Dict[str, Any]) -> Optional[Finding]:
+    """A take whose k-replication rode the snapwire transport showed
+    wire distress: any deadline-missed or failed push warns
+    (replication is limping — acks still honest, but each failure
+    burned a deadline/retry episode), and wire failures combined with a
+    MAJORITY of the acked bytes having ridden the synchronous
+    write-through path is critical — the transport is effectively
+    absent and every "RAM-speed" ack is paying storage latency before
+    it returns. Write-throughs WITHOUT wire failures (healthy pushes,
+    full peers) are a capacity problem, not a transport one, and stay
+    out of this rule."""
+    if report.get("kind") != "take":
+        return None
+    reps = [
+        (s.get("tier") or {}).get("replication")
+        for s in _ranks(report)
+        if (s.get("tier") or {}).get("replication")
+    ]
+    if not reps:
+        return None
+    deadline_misses = sum(
+        int(r.get("deadline_misses") or 0) for r in reps
+    )
+    retries = sum(int(r.get("retries") or 0) for r in reps)
+    push_failures = sum(int(r.get("push_failures") or 0) for r in reps)
+    wt_bytes = sum(int(r.get("write_through_bytes") or 0) for r in reps)
+    replicated_bytes = sum(
+        int(r.get("replicated_ack_bytes") or 0) for r in reps
+    )
+    acked = wt_bytes + replicated_bytes
+    fraction = wt_bytes / acked if acked > 0 else 0.0
+    # The critical arm requires actual WIRE distress behind the
+    # write-through bytes: a capacity-degraded take with a healthy
+    # transport (every push acked, peers simply full) is a hot-tier
+    # sizing problem, not a network one — misdiagnosing it critical
+    # would send the operator chasing a phantom transport failure.
+    wire_failed = deadline_misses > 0 or push_failures > 0
+    if not wire_failed:
+        return None
+    severity = "critical" if fraction > 0.5 else "warn"
+    pushes = sum(int(r.get("pushes") or 0) for r in reps)
+    return Finding(
+        rule="replication-degraded",
+        severity=severity,
+        title=(
+            f"hot-tier replication degraded: {deadline_misses} deadline "
+            f"miss(es), {100 * fraction:.0f}% of acked bytes rode the "
+            f"synchronous write-through path"
+        ),
+        evidence={
+            "deadline_misses": deadline_misses,
+            "retries": retries,
+            "pushes": pushes,
+            "push_failures": push_failures,
+            "write_through_bytes": wt_bytes,
+            "replicated_ack_bytes": replicated_bytes,
+            "write_through_byte_fraction": round(fraction, 3),
+        },
+        remediation=(
+            "peer pushes are missing TPUSNAPSHOT_REPLICATION_DEADLINE_S "
+            "or exhausting TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S: check "
+            "peer-process health (hottier.peer logs), the address book "
+            "(TPUSNAPSHOT_HOT_TIER_ADDRS), and network latency between "
+            "hosts. Acks stay honest either way — degraded puts write "
+            "through to the durable tier BEFORE acking — but every "
+            "write-through ack pays storage latency instead of RAM "
+            "latency, eroding the tier's whole point."
+        ),
+    )
+
+
 def _rule_read_plane_degraded(report: Dict[str, Any]) -> Optional[Finding]:
     """A restore routed through the snapserve read plane leaked reads
     to direct backend access: >0 fallbacks fire a warning (the restore
@@ -804,6 +883,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_durability_lag,
     _rule_missing_summary,
     _rule_hot_tier_degraded,
+    _rule_replication_degraded,
     _rule_read_plane_degraded,
     _rule_dedup_ineffective,
 ]

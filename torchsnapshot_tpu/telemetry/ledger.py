@@ -76,7 +76,15 @@ source operation did not produce them::
                                          # codec stage; null = no codec
                 } | null,
       "tier": {"hot_objects", "hot_bytes", "fallback_objects",
-               "fallback_bytes", "degraded_peers": [host, ...]} | null,
+               "fallback_bytes", "degraded_peers": [host, ...],
+               "replication": {           # takes whose replication rode
+                 "pushes", "payload_bytes",  # the snapwire transport
+                 "wire_bytes",
+                 "delta_ratio",           # wire/payload through chunk
+                                          # delta + codec (unchanged
+                                          # retake certifies < 0.10)
+                 "retries", "deadline_misses",
+                 "write_through_bytes"} | absent} | null,
                                          # hot-tier attribution (restores
                                          # with the hot tier enabled)
       "read_plane": {"remote_objects", "remote_bytes",
@@ -538,12 +546,14 @@ def _tier_totals(
     summaries: List[Optional[Dict[str, Any]]]
 ) -> Optional[Dict[str, Any]]:
     """Aggregate per-rank hot-tier blocks (hottier/) into the digest's
-    ``tier`` field. None when no rank recorded tier traffic (tier off,
-    or a take — only restores attribute tier reads)."""
+    ``tier`` field. None when no rank recorded tier traffic: restores
+    attribute tier reads; takes whose replication crossed the snapwire
+    transport attribute a ``replication`` sub-block (with the per-take
+    ``delta_ratio`` — wire bytes over logical payload bytes)."""
     noted = [s.get("tier") for s in summaries if s and s.get("tier")]
     if not noted:
         return None
-    return {
+    out: Dict[str, Any] = {
         "hot_objects": sum(int(t.get("hot_objects") or 0) for t in noted),
         "hot_bytes": sum(int(t.get("hot_bytes") or 0) for t in noted),
         "fallback_objects": sum(
@@ -556,6 +566,29 @@ def _tier_totals(
             {int(p) for t in noted for p in (t.get("degraded_peers") or [])}
         ),
     }
+    reps = [
+        t["replication"] for t in noted if isinstance(t, dict)
+        and t.get("replication")
+    ]
+    if reps:
+        payload = sum(int(r.get("payload_bytes") or 0) for r in reps)
+        wire = sum(int(r.get("wire_bytes") or 0) for r in reps)
+        out["replication"] = {
+            "pushes": sum(int(r.get("pushes") or 0) for r in reps),
+            "payload_bytes": payload,
+            "wire_bytes": wire,
+            "delta_ratio": (
+                round(wire / payload, 4) if payload > 0 else None
+            ),
+            "retries": sum(int(r.get("retries") or 0) for r in reps),
+            "deadline_misses": sum(
+                int(r.get("deadline_misses") or 0) for r in reps
+            ),
+            "write_through_bytes": sum(
+                int(r.get("write_through_bytes") or 0) for r in reps
+            ),
+        }
+    return out
 
 
 def _read_plane_totals(

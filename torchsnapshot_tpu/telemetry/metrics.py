@@ -359,6 +359,27 @@ HOT_TIER_DEGRADED_PUTS = (
     "tpusnapshot_hot_tier_degraded_puts_total"  # counter
 )
 HOT_TIER_BUFFERED_BYTES = "tpusnapshot_hot_tier_buffered_bytes"  # gauge
+# snapwire (hottier/transport.py): the cross-host replication wire.
+# pushes = acked replica pushes; bytes = logical payload bytes pushed;
+# delta_bytes = bytes that actually crossed the wire after chunk-delta
+# + codec (the unchanged-retake case sends <10% of payload); retries =
+# transport-failure retry attempts under the jitter/budget policy;
+# deadline_misses = RPCs that blew TPUSNAPSHOT_REPLICATION_DEADLINE_S.
+HOT_TIER_REPLICATION_PUSHES = (
+    "tpusnapshot_hot_tier_replication_pushes_total"  # counter
+)
+HOT_TIER_REPLICATION_BYTES = (
+    "tpusnapshot_hot_tier_replication_bytes_total"  # counter
+)
+HOT_TIER_REPLICATION_DELTA_BYTES = (
+    "tpusnapshot_hot_tier_replication_delta_bytes_total"  # counter
+)
+HOT_TIER_REPLICATION_RETRIES = (
+    "tpusnapshot_hot_tier_replication_retries_total"  # counter
+)
+HOT_TIER_REPLICATION_DEADLINE_MISSES = (
+    "tpusnapshot_hot_tier_replication_deadline_misses_total"  # counter
+)
 # Durability-lag accounting (snapscope): per-object ack→drained, the
 # per-take commit-ack→.tierdown window, and the live undrained bytes of
 # committed roots (the RPO exposure the sampler/SLO engine bound).

@@ -115,6 +115,21 @@ class FlightRecorder:
         self._phases: Dict[str, float] = {}
         self._pipeline: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # Take-side hot-tier replication window (snapwire): opened at
+        # recorder birth so every commit route — sync, async, KV,
+        # storage — attributes the same window. None when the tier is
+        # off; best-effort by contract (observability never fails a
+        # take).
+        self._replication_token: Any = None
+        if kind == "take":
+            try:
+                from torchsnapshot_tpu import hottier
+
+                self._replication_token = hottier.replication_stats_begin()
+            except Exception:
+                logger.debug(
+                    "replication window open failed", exc_info=True
+                )
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -202,6 +217,25 @@ class FlightRecorder:
             },
         }
         summary.update(pipeline.get("extra", {}))
+        if self._replication_token is not None:
+            # Close the snapwire window: the take's tier.replication
+            # block (pushes / delta_ratio / deadline misses / acked-
+            # bytes split) — what the replication-degraded doctor rule
+            # and the ledger's tier field read. Absent when the window
+            # saw no wire traffic.
+            try:
+                from torchsnapshot_tpu import hottier
+
+                block = hottier.replication_stats_collect(
+                    self._replication_token
+                )
+            except Exception:
+                logger.debug(
+                    "replication window collect failed", exc_info=True
+                )
+                block = None
+            if block:
+                summary.setdefault("tier", {})["replication"] = block
         # Goodput attribution at summary time (present only once the
         # accountant saw a train loop or a checkpoint wait): the doctor's
         # checkpoint-overhead-above-budget rule and the ledger's goodput
