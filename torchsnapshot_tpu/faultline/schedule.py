@@ -32,6 +32,13 @@ Fault kinds:
   ``kill_host`` SIGKILLs the process and aborts its in-flight transport
   connections, so a blocked socket read observes the loss within the
   RPC deadline instead of hanging until timeout.
+- **host flap** (``flap_host``) — deterministic lose-then-rejoin churn:
+  the host is lost exactly like ``lose_host`` at the matched boundary,
+  then revived ``revive_after_ops`` boundaries later — a wire-backed
+  peer as a FRESH subprocess one membership generation up
+  (``hottier.repair.respawn_host``; its empty store is never trusted
+  with the predecessor's replicas), an in-process host alive-and-empty.
+  The building block of the snapmend host-churn repair tests.
 - **wire faults** (``drop_conn`` / ``torn_frame`` / ``slow_wire``) —
   the snapwire replication transport's failure modes, armed at a
   deterministic ``hottier.replicate`` boundary and consumed by the next
@@ -115,6 +122,7 @@ class FaultRule:
     kind: str  # "transient" | "permanent" | "torn" | "latency" | "crash"
     #          | "hostloss" | "killserver"
     #          | "drop_conn" | "torn_frame" | "slow_wire"  (snapwire)
+    #          | "flap"  (snapmend: lose-then-revive churn)
     op: str = "*"
     path: str = "*"
     nth: int = 1
@@ -124,6 +132,10 @@ class FaultRule:
     torn: Optional[TornWrite] = None
     error_factory: Optional[Callable[[str, str], Exception]] = None
     host: Optional[int] = None  # hostloss: which peer host dies
+    # flap: how many further op boundaries after the loss until the
+    # host comes back (a wire-backed peer as a FRESH subprocess one
+    # membership generation up; an in-process host empty).
+    revive_after_ops: Optional[int] = None
     _hits: int = field(default=0, repr=False)
     _fired: int = field(default=0, repr=False)
 
@@ -395,6 +407,33 @@ class FaultSchedule:
         )
         return self
 
+    def flap_host(
+        self,
+        host: int,
+        revive_after_ops: int = 1,
+        op: str = "*",
+        path: str = "*",
+        nth: int = 1,
+    ) -> "FaultSchedule":
+        """snapmend: deterministic lose-then-REJOIN churn. Peer host
+        ``host`` is lost exactly like :meth:`lose_host` at the ``nth``
+        op matching the globs (a wire-backed peer's subprocess is
+        really SIGKILLed), then revived ``revive_after_ops`` op
+        boundaries later: a wire-backed host comes back as a FRESH
+        subprocess one membership generation up (``repair.respawn_host``
+        — empty store, never trusted with its predecessor's replicas),
+        an in-process host via ``tier.revive_host`` (alive, empty).
+        Both the loss and the rejoin ride the op stream, so replaying
+        the same pipeline replays the same churn — the building block
+        of the host-churn repair tests (docs/FAULTS.md)."""
+        self.rules.append(
+            FaultRule(
+                kind="flap", op=op, path=path, nth=nth, times=1,
+                host=host, revive_after_ops=max(1, int(revive_after_ops)),
+            )
+        )
+        return self
+
 
 @dataclass
 class FaultRecord:
@@ -420,6 +459,10 @@ class FaultController:
         self.crashed = False
         self.records: List[FaultRecord] = []
         self._lock = threading.Lock()
+        # flap_host revivals due at a future op index: (revive_at, host).
+        # Popped at boundary entry and performed OUTSIDE the lock (a
+        # wire-backed revival spawns a real subprocess).
+        self._pending_revivals: List[Tuple[int, int]] = []
 
     # ---------------------------------------------------------- internals
 
@@ -436,9 +479,55 @@ class FaultController:
             "fault_injected", op=op, path=path, kind=kind, op_index=idx
         )
 
+    def _revive_flapped_host(self, host: int, op: str, path: str) -> None:
+        """Bring a flapped host back (lock NOT held — a wire-backed
+        revival spawns a real subprocess): remote peers return as a
+        FRESH process one membership generation up, in-process hosts
+        simply come back alive and empty. Either way the revived host
+        holds none of its predecessor's replicas — re-replication is
+        the repair plane's job, which is the point of the rule."""
+        from ..hottier import repair as ht_repair
+        from ..hottier import tier as ht_tier
+
+        try:
+            if ht_tier.remote_host(host) is not None:
+                ht_repair.respawn_host(host)
+            else:
+                ht_tier.revive_host(host)
+        except Exception as e:
+            # A failed rejoin is a host that stayed lost — the repair
+            # plane keeps re-replicating around it; the schedule streams
+            # on deterministically either way.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                f"flap_host revival of host {host} failed: {e!r}"
+            )
+            return
+        with self._lock:
+            # The revival is in place when THIS boundary's op runs, and
+            # on_op has not incremented yet — stamp the index that op
+            # is about to get, not the previous boundary's.
+            self._record(self.op_index + 1, op, path, "revive")
+
     def on_op(self, op: str, path: str) -> Optional[TornWrite]:
         """Announce one op boundary. Raises the scheduled fault, if any;
         returns a :class:`TornWrite` the caller must apply, or None."""
+        due_revivals: List[int] = []
+        with self._lock:
+            if self._pending_revivals and not self.crashed:
+                upcoming = self.op_index + 1
+                still_pending: List[Tuple[int, int]] = []
+                for at, host in self._pending_revivals:
+                    if at <= upcoming:
+                        due_revivals.append(host)
+                    else:
+                        still_pending.append((at, host))
+                self._pending_revivals = still_pending
+        for host in due_revivals:
+            # Before this boundary's own faults: a revival scheduled N
+            # ops after the loss is in place when the Nth op runs.
+            self._revive_flapped_host(host, op, path)
         sleep_s = 0.0
         torn: Optional[TornWrite] = None
         with self._lock:
@@ -465,6 +554,17 @@ class FaultController:
                     from ..hottier import kill_host
 
                     kill_host(rule.host)
+                    continue
+                if rule.kind == "flap":
+                    # Lose now (exactly lose_host: a wire peer is really
+                    # SIGKILLed), rejoin revive_after_ops boundaries on.
+                    self._record(idx, op, path, "flap")
+                    from ..hottier import kill_host
+
+                    kill_host(rule.host)
+                    self._pending_revivals.append(
+                        (idx + (rule.revive_after_ops or 1), rule.host)
+                    )
                     continue
                 if rule.kind in ("drop_conn", "torn_frame", "slow_wire"):
                     self._record(idx, op, path, rule.kind)

@@ -42,6 +42,8 @@ from .runtime import (
     is_enabled,
     is_payload_path,
     reconcile_hot_tier,
+    repair_plane,
+    repair_tick,
     replication_stats_begin,
     replication_stats_collect,
     reset_pending,
@@ -53,8 +55,11 @@ from .runtime import (
 from .tier import (
     HostLostError,
     buffered_roots,
+    condemn_host,
+    host_generation,
     kill_host,
     live_hosts,
+    live_replicas,
     register_remote_host,
     remote_host,
     remote_hosts,
@@ -63,7 +68,7 @@ from .tier import (
     total_buffered_bytes,
     unregister_remote_host,
 )
-from . import peer, transport  # noqa: F401  (snapwire submodules)
+from . import peer, repair, transport  # noqa: F401  (snapwire/snapmend)
 
 __all__ = [
     "BYTES_ENV_VAR",
@@ -73,21 +78,27 @@ __all__ = [
     "TIERDOWN_FNAME",
     "TieredPlugin",
     "buffered_roots",
+    "condemn_host",
     "disable_hot_tier",
     "drain_now",
     "durability_lag_s",
     "enable_hot_tier",
     "forget_root",
+    "host_generation",
     "hot_tier",
     "introspect",
     "is_enabled",
     "is_payload_path",
     "kill_host",
     "live_hosts",
+    "live_replicas",
     "peer",
     "reconcile_hot_tier",
     "register_remote_host",
     "remote_host",
+    "repair",
+    "repair_plane",
+    "repair_tick",
     "replication_stats_begin",
     "replication_stats_collect",
     "remote_hosts",

@@ -58,8 +58,14 @@ class PeerServer:
         host: str = "127.0.0.1",
         port: int = 0,
         capacity_bytes: Optional[int] = None,
+        generation: int = 0,
     ) -> None:
         self.host_id = host_id
+        # Membership generation (snapmend): stamped by whoever spawned
+        # this incarnation and echoed in every ping, so a supervisor
+        # can refuse a stale predecessor process that wakes up after
+        # its host id moved on to a fresh generation.
+        self.generation = int(generation)
         self.capacity_bytes = (
             capacity_bytes
             if capacity_bytes is not None
@@ -235,7 +241,15 @@ class PeerServer:
                 }
                 return {**base, "ok": True, "occupancy": occ}, b""
             if op == "ping":
-                return {**base, "ok": True, "host": self.host_id}, b""
+                return (
+                    {
+                        **base,
+                        "ok": True,
+                        "host": self.host_id,
+                        "generation": self.generation,
+                    },
+                    b"",
+                )
             return (
                 {
                     **base,
@@ -427,13 +441,16 @@ def start_local_peer(
     host_id: int,
     capacity_bytes: Optional[int] = None,
     register: bool = True,
+    generation: int = 0,
 ):
     """Run a peer server on a daemon thread of THIS process (real
     sockets, no spawn cost — the fast-test form). With ``register``
     the matching :class:`~.transport.RemotePeer` is registered so the
     tier routes host ``host_id`` over the wire; returns
     ``(server, peer_or_None)``."""
-    server = PeerServer(host_id, capacity_bytes=capacity_bytes)
+    server = PeerServer(
+        host_id, capacity_bytes=capacity_bytes, generation=generation
+    )
 
     def _run() -> None:
         async def _main() -> None:
@@ -475,6 +492,7 @@ def start_local_peer(
             host_id,
             server.addr,
             capacity_bytes=capacity_bytes,
+            generation=generation,
         )
     return server, peer
 
@@ -484,6 +502,8 @@ def spawn_peer(
     capacity_bytes: Optional[int] = None,
     register: bool = True,
     timeout_s: float = _SPAWN_TIMEOUT_S,
+    generation: int = 0,
+    port_file: Optional[str] = None,
 ):
     """Spawn a REAL peer subprocess (``python -m
     torchsnapshot_tpu.hottier.peer``) on an ephemeral port, discover
@@ -491,10 +511,22 @@ def spawn_peer(
     register its :class:`~.transport.RemotePeer`. Returns
     ``(process, addr, peer_or_None)`` — killing ``process`` with
     SIGKILL is a real host loss (``tier.kill_host`` does exactly that
-    for registered spawned peers)."""
-    fd, port_file = tempfile.mkstemp(prefix="hottier-peer-", suffix=".addr")
-    os.close(fd)
-    os.unlink(port_file)  # the peer writes it atomically when bound
+    for registered spawned peers).
+
+    ``generation`` stamps the membership incarnation (the repair
+    plane respawns a lost host one generation up). With ``port_file``
+    the bound address is KEPT at that path after discovery — the hot
+    tier's address-book file the supervisor hot-reloads on every
+    respawn, so sidecar tooling rediscovers the peer without a process
+    restart; without it a temp file is used and removed."""
+    keep_port_file = port_file is not None
+    if port_file is None:
+        fd, port_file = tempfile.mkstemp(
+            prefix="hottier-peer-", suffix=".addr"
+        )
+        os.close(fd)
+    if os.path.exists(port_file):
+        os.unlink(port_file)  # the peer writes it atomically when bound
     cmd = [
         sys.executable,
         "-m",
@@ -505,6 +537,8 @@ def spawn_peer(
         "127.0.0.1:0",
         "--port-file",
         port_file,
+        "--generation",
+        str(generation),
     ]
     if capacity_bytes is not None:
         cmd += ["--capacity-bytes", str(capacity_bytes)]
@@ -540,17 +574,25 @@ def spawn_peer(
             proc.kill()
         raise
     finally:
-        try:
-            os.unlink(port_file)
-        except OSError:
-            pass
+        if not keep_port_file:
+            try:
+                os.unlink(port_file)
+            except OSError:
+                pass
     peer = None
     if register:
         from .transport import connect_peer
 
         peer = connect_peer(
-            host_id, addr, process=proc, capacity_bytes=capacity_bytes
+            host_id,
+            addr,
+            process=proc,
+            capacity_bytes=capacity_bytes,
+            generation=generation,
         )
+        # The repair plane's respawn reuses the configured port-file so
+        # the address book on disk follows the host across generations.
+        peer.spawn_port_file = port_file if keep_port_file else None
     return proc, addr, peer
 
 
@@ -581,6 +623,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the bound host:port here once listening (lets "
         "spawning scripts discover an ephemeral port)",
     )
+    parser.add_argument(
+        "--generation",
+        type=int,
+        default=0,
+        help="membership generation this incarnation serves (snapmend "
+        "supervisors bump it per respawn; echoed in every ping)",
+    )
     args = parser.parse_args(argv)
     host, _, port = args.addr.rpartition(":")
     server = PeerServer(
@@ -588,6 +637,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         host=host or "127.0.0.1",
         port=int(port or 0),
         capacity_bytes=args.capacity_bytes,
+        generation=args.generation,
     )
 
     async def _main() -> None:

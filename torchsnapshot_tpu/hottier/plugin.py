@@ -130,8 +130,12 @@ class TieredPlugin(StoragePlugin):
             await self._inner.read(io_req)
             if attempted:
                 # The hot tier knew this object and every replica was
-                # dead/missing/corrupt: a counted degraded fallback.
+                # dead/missing/corrupt: a counted degraded fallback —
+                # and direct evidence of under-replication, so nudge
+                # the snapmend repair plane instead of waiting out its
+                # full interval.
                 rt.note_fallback_bytes(len(io_payload(io_req)))
+                rt.request_repair_scan()
             return
         await self._inner.read(io_req)
 

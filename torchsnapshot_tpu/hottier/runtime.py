@@ -239,6 +239,16 @@ class HotTierRuntime:
         }
         self._peer_failures: Dict[int, int] = {}
         self._reason_counts: Dict[str, int] = {}
+        # snapmend repair plane (repair.py): attached by enable_hot_tier
+        # when a repair mode is configured; None = no self-healing.
+        self.repair_plane: Any = None
+
+    def request_repair_scan(self) -> None:
+        """Nudge the repair plane (a degraded read just proved a
+        replica is gone — no reason to wait out the full interval)."""
+        plane = self.repair_plane
+        if plane is not None:
+            plane.request_scan()
 
     # ---------------------------------------------------------- placement
 
@@ -1480,6 +1490,7 @@ class HotTierRuntime:
                     "durability_lag_s": st.durability_lag_s,
                 }
             beat = self._drain_beat
+            plane = self.repair_plane
             doc: Dict[str, Any] = {
                 "rank": self.rank,
                 "world": self.world,
@@ -1517,6 +1528,11 @@ class HotTierRuntime:
         doc["hosts"] = {
             str(h): occ for h, occ in tier.host_occupancy().items()
         }
+        # snapmend: the repair/membership block (under-replication
+        # accounting, per-host generation + liveness, repair stats) —
+        # the sampler publishes it and the replication-underreplicated
+        # live rule and the ops CLI read it.
+        doc["repair"] = plane.introspect() if plane is not None else None
         telemetry.gauge(_metric_names.HOT_TIER_AT_RISK_BYTES).set(
             float(at_risk_bytes)
         )
@@ -1604,6 +1620,7 @@ def enable_hot_tier(
     k: Optional[int] = None,
     capacity_bytes: Optional[int] = None,
     drain: str = "background",
+    repair: Optional[str] = None,
     coord: Optional[Coordinator] = None,
 ) -> HotTierRuntime:
     """Turn the hot tier on process-wide: every storage plugin resolved
@@ -1613,10 +1630,19 @@ def enable_hot_tier(
     composes). ``rank``/``world`` default to the coord layer's identity
     (``jax.distributed`` on a pod, single-host otherwise); ``k`` and
     ``capacity_bytes`` default to ``TPUSNAPSHOT_HOT_TIER_K`` (2) and
-    ``TPUSNAPSHOT_HOT_TIER_BYTES`` (1 GiB per host)."""
+    ``TPUSNAPSHOT_HOT_TIER_BYTES`` (1 GiB per host).
+
+    ``repair`` attaches the snapmend self-healing plane (repair.py):
+    ``"background"`` supervises peers and repairs under-replication on
+    a daemon thread every ``TPUSNAPSHOT_REPAIR_INTERVAL_S``;
+    ``"manual"`` constructs the plane but leaves ``repair_tick()`` to
+    the caller (the fault harness's deterministic form); ``"off"``
+    (the default, or ``TPUSNAPSHOT_REPAIR_MODE`` when unset here)
+    disables it."""
     global _RUNTIME, _PREV_HOOK
     from .. import storage_plugin as _sp
     from .plugin import TieredPlugin
+    from .repair import MODE_ENV_VAR, RepairPlane
 
     with _ENABLE_LOCK:
         if _RUNTIME is not None:
@@ -1640,6 +1666,12 @@ def enable_hot_tier(
             ),
             drain=drain,
         )
+        if repair is None:
+            repair = (
+                os.environ.get(MODE_ENV_VAR) or "off"
+            ).strip().lower() or "off"
+        if repair != "off":
+            rt.repair_plane = RepairPlane(rt, mode=repair)
 
         def _hook(plugin, url):
             base = (
@@ -1657,6 +1689,8 @@ def enable_hot_tier(
         from . import transport as _transport
 
         _transport.register_peers_from_env()
+        if rt.repair_plane is not None:
+            rt.repair_plane.start()
         return rt
 
 
@@ -1689,6 +1723,11 @@ def disable_hot_tier(flush: bool = True, timeout_s: float = 120.0) -> None:
             # faultline SimulatedCrash striking a drain op) must not
             # leak the wrap hook and the runtime global, or the tier
             # could never be disabled or re-enabled again.
+            if rt.repair_plane is not None:
+                try:
+                    rt.repair_plane.close()
+                except Exception as e:
+                    logger.warning(f"repair plane close failed: {e!r}")
             rt.stop()
             rt.active = False
             _sp.set_plugin_wrap_hook(_PREV_HOOK)
@@ -1715,6 +1754,20 @@ def drain_now() -> None:
         rt.drain_now()
 
 
+def repair_plane():
+    """The attached snapmend repair plane (None when repair is off)."""
+    rt = _RUNTIME
+    return rt.repair_plane if rt is not None else None
+
+
+def repair_tick() -> Optional[Dict[str, Any]]:
+    """Run one synchronous supervise→restart→repair pass (manual-mode
+    tests and the fault harness; also usable to force an immediate pass
+    on a background plane). None when no plane is attached."""
+    plane = repair_plane()
+    return plane.tick() if plane is not None else None
+
+
 def wait_drained(timeout_s: float = 120.0) -> bool:
     rt = _RUNTIME
     return rt.wait_drained(timeout_s=timeout_s) if rt is not None else True
@@ -1738,6 +1791,11 @@ def reset_pending() -> None:
         rt._progress_start.clear()
         rt.drain_error = None
         rt._cond.notify_all()
+    plane = rt.repair_plane
+    if plane is not None:
+        # Crash-replay determinism: every replay starts with a fresh
+        # under-replication clock and a live (un-crashed) plane.
+        plane.reset_for_replay()
 
 
 def introspect() -> Optional[Dict[str, Any]]:

@@ -104,6 +104,16 @@ _KEY_HOSTS: Dict[str, List[int]] = {}
 _REMOTE: Dict[int, object] = {}
 _REMOTE_SHADOW: Dict[tuple, Dict[str, object]] = {}
 
+# Generation-stamped membership (snapmend, repair.py): each host id
+# carries a monotonically increasing generation, bumped every time a
+# NEW peer process takes the id over (register after a loss/respawn).
+# A respawned peer starts with an empty store — trusting it to hold its
+# predecessor's replicas would turn one SIGKILL into silent
+# under-replication — so the client-side shadow for the host is
+# invalidated at every generation change and every view answers only
+# from entries of the CURRENT generation's peer.
+_HOST_GEN: Dict[int, int] = {}
+
 # Peer-SERVER scope (peer.py): when an in-process peer serves a host id
 # this same process also has registered as remote, the server half must
 # address the LOCAL store — otherwise its tier calls would route back
@@ -136,7 +146,10 @@ def _route_peer(host_id: int):
 def register_remote_host(host_id: int, peer) -> None:
     """Back virtual host ``host_id`` with a remote peer client
     (transport.RemotePeer): every tier operation addressing it crosses
-    the wire from here on."""
+    the wire from here on. Re-registering an id whose previous peer is
+    gone (condemned/killed/closed) is a GENERATION CHANGE: the shadow
+    entries of the predecessor are invalidated — the new process holds
+    none of its replicas and must never be credited with them."""
     with _TIER_LOCK:
         if host_id in _HOSTS and _HOSTS[host_id].objects:
             raise RuntimeError(
@@ -144,7 +157,33 @@ def register_remote_host(host_id: int, peer) -> None:
                 f"cannot re-register it as remote"
             )
         _HOSTS.pop(host_id, None)
+        prev = _REMOTE.get(host_id)
+        if prev is not None and prev is not peer:
+            for hk in [k for k in _REMOTE_SHADOW if k[0] == host_id]:
+                del _REMOTE_SHADOW[hk]
         _REMOTE[host_id] = peer
+        gen = getattr(peer, "generation", None)
+        if gen is None:
+            gen = _HOST_GEN.get(host_id, 0) + (0 if prev is None else 1)
+        _HOST_GEN[host_id] = max(int(gen), _HOST_GEN.get(host_id, 0))
+        _update_buffered_gauge()
+
+
+def host_generation(host_id: int) -> int:
+    """The membership generation of ``host_id``'s current peer (0 for a
+    host never lost/re-registered)."""
+    with _TIER_LOCK:
+        return _HOST_GEN.get(host_id, 0)
+
+
+def note_host_generation(host_id: int, generation: int) -> None:
+    """Raise the membership view of ``host_id`` to ``generation``
+    (monotonic; lower observations are ignored). Called by a transport
+    probe that learned the server's true generation — a client rebuilt
+    from the generation-less address book starts at 0 and adopts the
+    respawned server's generation on first contact."""
+    with _TIER_LOCK:
+        _HOST_GEN[host_id] = max(int(generation), _HOST_GEN.get(host_id, 0))
 
 
 def unregister_remote_host(host_id: int, kill_spawned: bool = True) -> None:
@@ -217,6 +256,98 @@ def kill_host(host_id: int) -> None:
         _update_buffered_gauge()
 
 
+def condemn_host(host_id: int, only_if: Optional[object] = None) -> None:
+    """Classify a wire-backed host LOST without signalling its process
+    (snapmend: a hung-not-dead peer — SIGSTOP, network partition —
+    cannot be killed from here, but must stop being trusted). The
+    RemotePeer is latched dead and its connections aborted, so every
+    later op raises :class:`HostLostError`; the client-side shadow is
+    cleared so occupancy/replica counting stops crediting the lost
+    process. The peer stays REGISTERED (routing to a condemned host
+    must fail loudly, never silently fall back to a fresh in-process
+    store) until a replacement generation registers over it. For an
+    in-process host this is exactly :func:`kill_host`.
+
+    ``only_if`` pins the verdict to the peer OBJECT the caller judged:
+    when a replacement has been registered over the id since (a
+    respawn, an external supervisor's re-registration), the call is a
+    no-op — a healthy fresh peer must never be condemned on a stale
+    view of its predecessor."""
+    with _TIER_LOCK:
+        peer = _REMOTE.get(host_id)
+        if (
+            peer is not None
+            and only_if is not None
+            and peer is not only_if
+        ):
+            return
+    if peer is None:
+        if only_if is not None:
+            return  # the judged remote peer is no longer registered
+        kill_host(host_id)
+        return
+    condemn = getattr(peer, "condemn", None)
+    if condemn is not None:
+        condemn()
+    else:  # duck-typed peer without the latch: a kill is the best we have
+        peer.kill()
+    with _TIER_LOCK:
+        if _REMOTE.get(host_id) is not peer:
+            # A replacement registered over the id while the judged
+            # peer was being condemned outside the lock. Its
+            # registration already invalidated the predecessor's
+            # shadow, so every entry present now belongs to the
+            # REPLACEMENT (it may already hold fresh replicas) and
+            # must survive.
+            return
+        for hk in [k for k in _REMOTE_SHADOW if k[0] == host_id]:
+            del _REMOTE_SHADOW[hk]
+        _update_buffered_gauge()
+
+
+def live_replicas(key: str, tag: Optional[str] = None) -> List[int]:
+    """Hosts whose CURRENT store verifiably holds a replica of ``key``
+    (with ``tag``, only replicas of exactly those bytes) — the repair
+    plane's replica count. Unlike :func:`replica_hosts_for` (the
+    rendezvous CLAIM, deliberately left stale so readers discover death
+    on access), this answers from live state only: an in-process host
+    must be alive and hold the object; a remote host must have a
+    current-generation shadow entry (condemned/killed hosts had theirs
+    invalidated)."""
+    with _TIER_LOCK:
+        out: List[int] = []
+        for h in _KEY_HOSTS.get(key, []):
+            if h in _REMOTE:
+                peer = _REMOTE[h]
+                if not getattr(peer, "alive", False):
+                    continue
+                shadow = _REMOTE_SHADOW.get((h, key))
+                if shadow is not None and (
+                    tag is None or shadow["tag"] == tag
+                ):
+                    out.append(h)
+                continue
+            store = _HOSTS.get(h)
+            if store is None or not store.alive:
+                continue
+            obj = store.objects.get(key)
+            if obj is not None and (tag is None or obj.tag == tag):
+                out.append(h)
+        return out
+
+
+def replica_is_drained(key: str, host_id: int) -> Optional[bool]:
+    """The drained flag of ``key``'s replica on ``host_id`` (None when
+    no live replica there) — repaired replicas inherit it."""
+    with _TIER_LOCK:
+        shadow = _REMOTE_SHADOW.get((host_id, key))
+        if shadow is not None:
+            return bool(shadow["drained"])
+        store = _HOSTS.get(host_id)
+        obj = store.objects.get(key) if store is not None else None
+        return None if obj is None else bool(obj.drained)
+
+
 def revive_host(host_id: int) -> None:
     """Bring a host back (empty — preemption lost its RAM). Remote
     peers do not revive: a preempted host comes back as a NEW process
@@ -246,6 +377,7 @@ def reset_hot_tier() -> None:
         peers = list(_REMOTE.values())
         _REMOTE.clear()
         _REMOTE_SHADOW.clear()
+        _HOST_GEN.clear()
         _HOSTS.clear()
         _KEY_HOSTS.clear()
         _update_buffered_gauge()
@@ -320,6 +452,14 @@ def put_replica(
             key, bytes(data), tag, root, capacity_bytes=capacity_bytes
         )
         with _TIER_LOCK:
+            if _REMOTE.get(host_id) is not peer:
+                # The membership moved on mid-RPC (the peer was
+                # condemned/replaced while our push was in flight): the
+                # bytes may sit in a process nothing routes to anymore.
+                # Do NOT credit the shadow — report the placement
+                # failed so the caller places elsewhere (and the repair
+                # plane's count stays honest).
+                return False
             if stored:
                 _REMOTE_SHADOW[(host_id, key)] = {
                     "root": root.rstrip("/"),

@@ -285,9 +285,17 @@ class RemotePeer:
         addr: str,
         process: Any = None,
         capacity_bytes: Optional[int] = None,
+        generation: int = 0,
     ) -> None:
         self.host_id = host_id
         self.addr_str = addr
+        # Membership generation (snapmend): which incarnation of the
+        # host this client speaks to. A ping answered by a server of a
+        # DIFFERENT generation (a SIGCONT'd predecessor, a stale
+        # process on a reused port) is refused — probe() returns False
+        # instead of reviving a peer whose store belongs to a dead
+        # membership view.
+        self.generation = int(generation)
         host, _, port = addr.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self.process = process
@@ -324,9 +332,20 @@ class RemotePeer:
         with self._lock:
             return time.monotonic() < self._down_until
 
+    @property
+    def in_cooldown(self) -> bool:
+        """Inside the post-failure down cooldown right now (the repair
+        tick's background re-probe targets exactly these peers, so a
+        recovered host rejoins within one repair interval instead of
+        waiting for the next foreground push to trip over it)."""
+        return self._is_down()
+
     def probe(self, deadline_s: Optional[float] = None) -> bool:
         """Liveness probe: one un-retried ping RPC. A success clears a
-        down cooldown early."""
+        down cooldown early. A server answering with a DIFFERENT
+        membership generation is not a success — a stale predecessor
+        process (SIGCONT'd after its id moved on) must be refused, not
+        revived."""
         if self._killed:
             return False
         try:
@@ -338,10 +357,48 @@ class RemotePeer:
         except (_WireFailure, HostLostError):
             return False
         if resp.get("ok"):
+            server_gen = resp.get("generation")
+            if server_gen is not None and int(server_gen) < self.generation:
+                logger.warning(
+                    f"snapwire: peer at {self.addr_str} answered with "
+                    f"stale generation {server_gen} (expected "
+                    f"{self.generation}); refusing it"
+                )
+                return False
+            if server_gen is not None and int(server_gen) > self.generation:
+                # The SERVER is newer than this client's view — a
+                # respawned (gen-up) peer reached through a client
+                # rebuilt from the address book / port-file, which
+                # carry no generation and default to 0. The stale side
+                # is us, not the server: adopt its generation (and
+                # sync the tier's membership view) instead of
+                # condemning a healthy peer forever. Only a LOWER
+                # generation marks a stale predecessor.
+                logger.info(
+                    f"snapwire: peer at {self.addr_str} answers "
+                    f"generation {server_gen} (client view was "
+                    f"{self.generation}); adopting"
+                )
+                self.generation = int(server_gen)
+                from . import tier
+
+                tier.note_host_generation(self.host_id, self.generation)
             with self._lock:
                 self._down_until = 0.0
             return True
         return False
+
+    def condemn(self) -> None:
+        """Latch the peer dead WITHOUT signalling its process (snapmend:
+        a hung/unreachable host is declared lost by the supervisor — the
+        process may still exist, possibly on another machine). Every
+        later op raises :class:`~.tier.HostLostError`; in-flight socket
+        reads are aborted so nothing blocks out its full deadline."""
+        with self._lock:
+            if self._killed:
+                return
+            self._killed = True
+        self.abort_connections()
 
     def abort_connections(self) -> None:
         """Abort the pooled connection from any thread (deadline miss,
@@ -373,10 +430,13 @@ class RemotePeer:
         """The real ``lose_host``: SIGKILL the peer process (when this
         client spawned it) and abort in-flight connections, then latch
         the peer dead — every later op raises
-        :class:`~.tier.HostLostError` immediately."""
+        :class:`~.tier.HostLostError` immediately. A peer already
+        latched by :meth:`condemn` (which deliberately does NOT signal)
+        still gets its subprocess signalled here: kill() IS the reap,
+        and early-returning on the latch would leave a condemned hung
+        subprocess alive past every later reap, pinning its RAM for
+        the run."""
         with self._lock:
-            if self._killed:
-                return
             self._killed = True
         proc = self.process
         if proc is not None and proc.poll() is None:
@@ -838,17 +898,42 @@ def connect_peer(
     addr: str,
     process: Any = None,
     capacity_bytes: Optional[int] = None,
+    generation: int = 0,
 ) -> RemotePeer:
     """Create a :class:`RemotePeer` for ``addr`` and register it as the
     backing store of virtual host ``host_id`` — every tier operation
-    addressing that host now crosses the wire."""
+    addressing that host now crosses the wire. ``generation`` stamps
+    the membership incarnation (respawned peers register one higher
+    than their predecessor; see repair.py)."""
     from . import tier
 
     peer = RemotePeer(
-        host_id, addr, process=process, capacity_bytes=capacity_bytes
+        host_id,
+        addr,
+        process=process,
+        capacity_bytes=capacity_bytes,
+        generation=generation,
     )
     tier.register_remote_host(host_id, peer)
     return peer
+
+
+def parse_addrs_spec(spec: str) -> Dict[str, str]:
+    """Raw ``host=addr`` entries of an address-book spec (format
+    ``"1=host:port,2=host:port"``), preserved verbatim — no validation,
+    so a rewrite (repair.py's hot-reload) round-trips malformed-but-
+    diagnosable entries instead of silently dropping them. The
+    registration path validates what it consumes."""
+    entries: Dict[str, str] = {}
+    for entry in (spec or "").strip().split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        host_part, sep, addr = entry.partition("=")
+        # A separator-less entry is kept (with an empty addr) so the
+        # registration path can still warn about it by name.
+        entries[host_part.strip()] = addr.strip() if sep else ""
+    return entries
 
 
 def register_peers_from_env() -> Dict[int, RemotePeer]:
@@ -858,23 +943,19 @@ def register_peers_from_env() -> Dict[int, RemotePeer]:
     only needs the address book in the environment."""
     from . import tier
 
-    spec = (os.environ.get(ADDRS_ENV_VAR) or "").strip()
     out: Dict[int, RemotePeer] = {}
-    if not spec:
-        return out
-    for entry in spec.split(","):
-        entry = entry.strip()
-        if not entry:
-            continue
-        host_part, sep, addr = entry.partition("=")
-        if not sep or not host_part.strip().isdigit() or ":" not in addr:
+    for host_part, addr in parse_addrs_spec(
+        os.environ.get(ADDRS_ENV_VAR) or ""
+    ).items():
+        if not host_part.isdigit() or ":" not in addr:
             logger.warning(
-                f"snapwire: malformed {ADDRS_ENV_VAR} entry {entry!r} "
-                f"(expected host_id=host:port); skipped"
+                f"snapwire: malformed {ADDRS_ENV_VAR} entry "
+                f"{host_part + '=' + addr!r} (expected host_id=host:port); "
+                f"skipped"
             )
             continue
         host_id = int(host_part)
         if tier.remote_host(host_id) is not None:
             continue
-        out[host_id] = connect_peer(host_id, addr.strip())
+        out[host_id] = connect_peer(host_id, addr)
     return out

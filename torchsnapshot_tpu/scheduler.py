@@ -347,19 +347,59 @@ class _BudgetCell:
     threads as their in-order prefix drains, racing the event loop's
     charge/refund."""
 
-    __slots__ = ("value", "_lock")
+    __slots__ = ("value", "_lock", "_charges", "_releases")
 
     def __init__(self, value: int) -> None:
         self.value = value
         self._lock = threading.Lock()
+        self._charges = 0
+        self._releases = 0
 
     def charge(self, nbytes: int) -> None:
         with self._lock:
             self.value -= nbytes
+            self._charges += 1
 
     def release(self, nbytes: int) -> None:
         with self._lock:
             self.value += nbytes
+            self._releases += 1
+
+    def charge_count(self) -> int:
+        with self._lock:
+            return self._charges
+
+    def release_count(self) -> int:
+        with self._lock:
+            return self._releases
+
+
+# Force-admission grace: when nothing is in flight on the event loop but
+# the head still cannot be admitted under budget, a completed consume's
+# deferred release may still be riding an engine/executor thread the OS
+# hasn't scheduled (H2D done-callbacks resolve after the consume task
+# does). Bound how long the pipeline waits for such a straggler before
+# it force-admits and accepts the overrun.
+_FORCE_ADMIT_GRACE_S = 0.5
+_FORCE_ADMIT_POLL_S = 0.005
+
+
+async def _straggler_release_landed(cell: _BudgetCell) -> bool:
+    """Wait up to the grace window for ANY release on ``cell``; True
+    means one landed and the caller should rescan under the refreshed
+    budget instead of force-admitting."""
+    if cell.charge_count() == 0:
+        # Nothing was ever charged, so no release can possibly be in
+        # flight — force-admit immediately (the solo over-budget head
+        # at t=0 must not pay the grace).
+        return False
+    baseline = cell.release_count()
+    deadline = time.monotonic() + _FORCE_ADMIT_GRACE_S
+    while time.monotonic() < deadline:
+        await asyncio.sleep(_FORCE_ADMIT_POLL_S)
+        if cell.release_count() != baseline:
+            return True
+    return cell.release_count() != baseline
 
 
 async def execute_read_reqs(
@@ -440,6 +480,15 @@ async def execute_read_reqs(
                 consumer = pending[0].buffer_consumer
                 cost = consumer.get_consuming_cost_bytes()
                 nothing_in_flight = not (reading or consumable or consuming)
+                if budget.value < cost and nothing_in_flight:
+                    # Same straggler grace as the device scan below:
+                    # split-assembly buffers release host budget from
+                    # executor threads after their consume task resolves.
+                    while (
+                        budget.value < cost
+                        and await _straggler_release_landed(budget)
+                    ):
+                        pass
                 if budget.value >= cost or nothing_in_flight:
                     rr = pending.popleft()
                     # Invariant the flow analysis cannot see: every
@@ -510,6 +559,10 @@ async def execute_read_reqs(
                         # frees.
                         budget_blocked = True
                         break
+                    if await _straggler_release_landed(device_budget):
+                        # A deferred release from an engine thread beat
+                        # the grace window — rescan before overrunning.
+                        continue
                     pick = 0
                 rr, buf, host_refund, ready_t = consumable[pick]
                 del consumable[pick]

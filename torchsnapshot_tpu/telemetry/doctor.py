@@ -63,6 +63,17 @@ dedup-ineffective         a chunked take's chunk-level dedup saved no
                           leaf) over >= TPUSNAPSHOT_DEDUP_MIN_BYTES of
                           chunked payload — chunk-grid overhead
                           without sub-leaf savings (chunkstore.py)
+replication-under-        LIVE-ONLY (telemetry/slo.py, like the live
+replicated                arm of durability-lag-above-budget):
+                          snapmend found committed undrained objects
+                          below k live replicas past one repair
+                          interval (warn), or the repair stalled past
+                          TPUSNAPSHOT_REPAIR_DEADLINE_S with the
+                          write-through escalation firing (critical).
+                          Flight reports carry no membership state, so
+                          this rule has no report-based arm here — the
+                          ops/slo CLIs surface it with the same
+                          exit-code contract
 ========================  =============================================
 
 Findings are observability, not judgment: every rule errs toward

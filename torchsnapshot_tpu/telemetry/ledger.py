@@ -127,6 +127,25 @@ append-only)::
       "durability_lag_s": ...,           # commit ack -> .tierdown
       "drained_objects": ..., "write_through_objects": ...
     }
+
+Repair event record (kind ``repair``, appended by the snapmend repair
+plane — hottier/repair.py — after any tick that re-replicated or
+escalated objects of a root; the ledger's durable trace of the
+self-healing loop)::
+
+    {
+      "format_version": 1,
+      "kind": "repair",
+      "ts_epoch_s": ..., "path": "<snapshot url>", "step": <int | null>,
+      "take_id": null,
+      "objects_repaired": ...,           # re-replicated back toward k
+      "bytes_repaired": ...,             # replica bytes placed
+      "repairs_failed": ...,             # no usable source survived
+      "escalated_write_throughs": ...,   # drain items actually run past
+                                         #   TPUSNAPSHOT_REPAIR_DEADLINE_S
+      "underreplicated_bytes": ...       # THIS root's bytes still below
+                                         #   k after the tick
+    }
 """
 
 import asyncio
@@ -748,4 +767,32 @@ def tierdown_record(
         ),
         "drained_objects": int(drained_objects),
         "write_through_objects": int(write_through_objects),
+    }
+
+
+def repair_record(
+    path: str,
+    objects_repaired: int = 0,
+    bytes_repaired: int = 0,
+    repairs_failed: int = 0,
+    escalated_write_throughs: int = 0,
+    underreplicated_bytes: int = 0,
+    take_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The repair event record (kind ``repair``) the snapmend plane
+    appends after a tick that re-replicated or escalated this root's
+    objects — the ledger's durable trace of the self-healing loop
+    (hottier/repair.py)."""
+    return {
+        "format_version": LEDGER_FORMAT_VERSION,
+        "kind": "repair",
+        "ts_epoch_s": round(time.time(), 3),
+        "path": path,
+        "step": None,  # stamped by append_for_snapshot
+        "take_id": take_id,
+        "objects_repaired": int(objects_repaired),
+        "bytes_repaired": int(bytes_repaired),
+        "repairs_failed": int(repairs_failed),
+        "escalated_write_throughs": int(escalated_write_throughs),
+        "underreplicated_bytes": int(underreplicated_bytes),
     }

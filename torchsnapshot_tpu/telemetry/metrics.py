@@ -390,6 +390,28 @@ HOT_TIER_TAKE_LAG = (
     "tpusnapshot_hot_tier_take_durability_lag_seconds"  # hist
 )
 HOT_TIER_AT_RISK_BYTES = "tpusnapshot_hot_tier_at_risk_bytes"  # gauge
+# snapmend (hottier/repair.py): the self-healing repair plane's
+# under-replication accounting — committed undrained bytes below k live
+# replicas right now, what the anti-entropy loop repaired, and the
+# deadline-bounded escalations to synchronous durable write-through.
+HOT_TIER_UNDERREPLICATED_BYTES = (
+    "tpusnapshot_hot_tier_underreplicated_bytes"  # gauge
+)
+HOT_TIER_REPAIR_OBJECTS = (
+    "tpusnapshot_hot_tier_repair_objects_total"  # counter
+)
+HOT_TIER_REPAIR_BYTES = (
+    "tpusnapshot_hot_tier_repair_bytes_total"  # counter
+)
+HOT_TIER_REPAIRS_FAILED = (
+    "tpusnapshot_hot_tier_repairs_failed_total"  # counter
+)
+HOT_TIER_REPAIR_ESCALATIONS = (
+    "tpusnapshot_hot_tier_repair_escalations_total"  # counter
+)
+HOT_TIER_REPAIR_TIME_TO_K = (
+    "tpusnapshot_hot_tier_repair_time_to_k_seconds"  # histogram
+)
 # Live scheduler budget state (snapscope): bytes currently charged
 # against the per-process memory budget and whether the pipeline is
 # stalled on it RIGHT NOW (0/1) — the point-in-time companions of the

@@ -147,6 +147,64 @@ def findings_of(state: Dict[str, Any]) -> List[Finding]:
 # -------------------------------------------------------------- rendering
 
 
+def _render_repair_lines(repair: Optional[Dict[str, Any]]) -> List[str]:
+    """The snapmend membership/repair block of the drain section:
+    per-host generation + liveness from the supervisor's view, the
+    at-risk (under-replicated) bytes with their age against the repair
+    deadline, and the repair loop's cumulative work. Omitted entirely
+    when the repair plane is off (``repair`` is None)."""
+    if not isinstance(repair, dict):
+        return []
+    lines: List[str] = []
+    under_objects = int(repair.get("underreplicated_objects") or 0)
+    under_bytes = int(repair.get("underreplicated_bytes") or 0)
+    oldest = repair.get("oldest_underreplicated_age_s")
+    parts = [
+        f"repair[{repair.get('mode', '?')}]:",
+        f"under-replicated {under_objects} obj "
+        f"({_HUMAN(under_bytes)} at risk)",
+    ]
+    if oldest is not None:
+        parts.append(
+            f"oldest {oldest:.1f}s/"
+            f"{float(repair.get('deadline_s') or 0):g}s deadline"
+        )
+    stats = repair.get("stats") or {}
+    if stats.get("objects_repaired"):
+        parts.append(
+            f"repaired {stats['objects_repaired']} obj "
+            f"({_HUMAN(stats.get('bytes_repaired') or 0)})"
+        )
+    if stats.get("escalated_write_throughs"):
+        parts.append(
+            f"ESCALATED {stats['escalated_write_throughs']} "
+            f"write-through(s)"
+        )
+    if stats.get("peer_restarts"):
+        parts.append(f"restarted {stats['peer_restarts']} peer(s)")
+    if repair.get("repair_error"):
+        parts.append(f"REPAIR DEAD: {repair['repair_error']}")
+    lines.append(" ".join(parts))
+    membership = repair.get("membership") or {}
+    if membership:
+        lines.append(
+            "membership: "
+            + " ".join(
+                f"h{h}:gen{v.get('current_generation', v.get('generation'))}"
+                + ("" if v.get("alive") else "(LOST)")
+                + (
+                    ""
+                    if v.get("restartable")
+                    else "[external]"
+                )
+                for h, v in sorted(
+                    membership.items(), key=lambda kv: int(kv[0])
+                )
+            )
+        )
+    return lines
+
+
 def _render_drain_section(state: Dict[str, Any]) -> List[str]:
     lines: List[str] = []
     for rank, rank_samples in sorted(state["samples_by_rank"].items()):
@@ -192,6 +250,10 @@ def _render_drain_section(state: Dict[str, Any]) -> List[str]:
                     for h, o in sorted(hosts.items())
                 )
                 lines.append(f"    hosts: {occ}")
+            lines.extend(
+                f"    {line}"
+                for line in _render_repair_lines(hot.get("repair"))
+            )
         for pipeline, s in sorted(sched.items()):
             if s.get("budget_in_use_bytes") or s.get("stalled"):
                 lines.append(

@@ -415,6 +415,121 @@ def rule_durability_lag_live(
     )
 
 
+def rule_replication_underreplicated(
+    samples: List[Dict[str, Any]]
+) -> Optional[Finding]:
+    """snapmend: committed undrained objects are below k live replicas
+    and the repair plane has had time to act. Warn once any object has
+    been under-replicated past one repair interval (the loop should
+    have repaired it by now); critical when the repair is STALLED —
+    under-replication has outlived ``TPUSNAPSHOT_REPAIR_DEADLINE_S``
+    and the plane is escalating to synchronous durable write-through
+    (or died outright), so the replication invariant is not coming back
+    on its own."""
+    hot = _hot_samples(samples)
+    if not hot:
+        return None
+    latest = hot[-1]
+    repair = latest.get("repair")
+    if not isinstance(repair, dict):
+        return None
+    under_objects = int(repair.get("underreplicated_objects") or 0)
+    under_bytes = int(repair.get("underreplicated_bytes") or 0)
+    oldest = repair.get("oldest_underreplicated_age_s")
+    repair_error = repair.get("repair_error")
+    # A dead plane IS the stall, independent of every age gate below:
+    # the introspect snapshot FREEZES at the crash (ages stop
+    # advancing, later losses are invisible), so gating critical on
+    # the frozen oldest-age would keep a dead plane at warn forever —
+    # and a loss after the crash would produce no finding at all.
+    plane_dead = repair_error is not None
+    interval_s = float(repair.get("interval_s") or 0.0)
+    deadline_s = float(repair.get("deadline_s") or 0.0)
+    dead_hosts = sorted(
+        h
+        for h, v in (repair.get("membership") or {}).items()
+        if not v.get("alive")
+    )
+    if under_objects <= 0 or oldest is None:
+        if not plane_dead:
+            return None
+        return Finding(
+            rule="replication-underreplicated",
+            severity="critical",
+            title=(
+                f"repair plane DEAD ({repair_error}); self-healing is "
+                f"off and under-replication after the crash is "
+                f"invisible to this snapshot"
+            ),
+            evidence={
+                "underreplicated_objects": under_objects,
+                "underreplicated_bytes": under_bytes,
+                "repair_error": repair_error,
+                "dead_hosts": dead_hosts,
+            },
+            remediation=(
+                "the repair plane crashed (repair_error); no peer "
+                "supervision, auto-restart, or re-replication is "
+                "running. Re-enable the hot tier (or run "
+                "hottier.repair_tick() manually) after fixing the "
+                "cause — host losses since the crash are NOT reflected "
+                "in this sample's counters."
+            ),
+        )
+    if oldest < interval_s and not plane_dead:
+        return None  # the loop has not had a full tick to act yet
+    stats = repair.get("stats") or {}
+    escalations = int(stats.get("escalated_write_throughs") or 0)
+    # escalation_attempts counts every deadline-passed tick (including
+    # loss-verdict debounce deferrals where no write-through ran yet) —
+    # the repair being past its deadline is the stall, whether or not
+    # a durable write has landed.
+    attempts = int(
+        stats.get("escalation_attempts") or 0
+    )
+    stalled = plane_dead or (
+        oldest >= deadline_s and (attempts > 0 or escalations > 0)
+    )
+    return Finding(
+        rule="replication-underreplicated",
+        severity="critical" if stalled else "warn",
+        title=(
+            f"{under_objects} committed object(s) ({under_bytes} bytes) "
+            f"below k live replicas for {oldest:.1f}s"
+            + (
+                f"; repair plane DEAD ({repair_error})"
+                if plane_dead
+                else (
+                    f"; repair stalled past the {deadline_s:g}s deadline "
+                    f"({escalations} write-through escalation(s))"
+                    if stalled
+                    else f" (repair interval {interval_s:g}s)"
+                )
+            )
+        ),
+        evidence={
+            "underreplicated_objects": under_objects,
+            "underreplicated_bytes": under_bytes,
+            "oldest_underreplicated_age_s": oldest,
+            "repair_interval_s": interval_s,
+            "repair_deadline_s": deadline_s,
+            "escalated_write_throughs": escalations,
+            "repair_error": repair_error,
+            "dead_hosts": dead_hosts,
+        },
+        remediation=(
+            "a host loss (or repair failure) left committed bytes "
+            "below their replication factor. Check peer-process health "
+            "and the membership view (telemetry.ops repair section); "
+            "lost restartable peers should respawn automatically "
+            "(TPUSNAPSHOT_REPAIR_AUTO_RESTART). Escalated objects are "
+            "already durable via write-through; if the plane died "
+            "(repair_error), re-enable the hot tier or run "
+            "hottier.repair_tick() after fixing the cause."
+        ),
+    )
+
+
 def evaluate_live(
     samples: List[Dict[str, Any]],
     budget_s: Optional[float] = None,
@@ -430,6 +545,7 @@ def evaluate_live(
             rule_stranded_drains(samples),
             rule_drain_backlog_growing(samples),
             rule_durability_lag_live(samples, budget_s=budget_s),
+            rule_replication_underreplicated(samples),
         )
         if f is not None
     ]
@@ -606,6 +722,64 @@ def _self_test() -> int:
     ), per_rank
     steady = {r: [hot(r + 1, 1.0)] * 3 for r in range(3)}
     assert not evaluate_live_by_rank(steady), "steady state is not growth"
+
+    # snapmend: the replication-underreplicated rule over the repair
+    # block of the sample (warn past one interval; critical once the
+    # repair stalled past deadline with escalation firing).
+    def repair_sample(age, escalations=0, error=None, objs=1):
+        s = hot(0, None)
+        s["hot_tier"]["repair"] = {
+            "interval_s": 2.0,
+            "deadline_s": 30.0,
+            "underreplicated_objects": objs,
+            "underreplicated_bytes": 4096 * objs,
+            "oldest_underreplicated_age_s": age,
+            "repair_error": error,
+            "stats": {"escalated_write_throughs": escalations},
+            "membership": {"1": {"alive": False, "generation": 1}},
+        }
+        return s
+
+    fresh = evaluate_live([repair_sample(0.5)])
+    assert not any(
+        f.rule == "replication-underreplicated" for f in fresh
+    ), fresh
+    warned = evaluate_live([repair_sample(5.0)])
+    assert any(
+        f.rule == "replication-underreplicated" and f.severity == "warn"
+        for f in warned
+    ), warned
+    stalled = evaluate_live([repair_sample(45.0, escalations=2)])
+    assert any(
+        f.rule == "replication-underreplicated"
+        and f.severity == "critical"
+        for f in stalled
+    ), stalled
+    healed = evaluate_live([repair_sample(45.0, objs=0)])
+    assert not any(
+        f.rule == "replication-underreplicated" for f in healed
+    ), healed
+    # A dead plane is critical regardless of the FROZEN oldest-age
+    # (introspect stops advancing at the crash)...
+    dead_young = evaluate_live(
+        [repair_sample(5.0, error="SimulatedCrash()")]
+    )
+    assert any(
+        f.rule == "replication-underreplicated"
+        and f.severity == "critical"
+        for f in dead_young
+    ), dead_young
+    # ...and even with nothing recorded under-replicated: losses after
+    # the crash are invisible to the frozen snapshot.
+    dead_blind = evaluate_live(
+        [repair_sample(45.0, objs=0, error="SimulatedCrash()")]
+    )
+    assert any(
+        f.rule == "replication-underreplicated"
+        and f.severity == "critical"
+        and "DEAD" in f.title
+        for f in dead_blind
+    ), dead_blind
     print("slo self-test OK")
     return 0
 
