@@ -1,0 +1,38 @@
+"""Fixture: client half of a wire transport that violates SNAP010-012."""
+
+import asyncio
+import time
+
+from torchsnapshot_tpu import wire
+
+IDEMPOTENT_OPS = frozenset({"get", "put"})
+
+
+class BadClient:
+    def __init__(self, host, port):
+        self.host = host
+        self.port = port
+
+    async def rpc(self, doc, payload):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        await wire.send_frame(writer, doc, payload)
+        return await wire.recv_frame(reader)
+
+    def call(self, header, payload=b""):
+        while True:
+            try:
+                return asyncio.run(self.rpc(header, payload))
+            except OSError:
+                time.sleep(1.0)
+
+    def fetch(self, key):
+        resp, _ = self.call({"v": 1, "op": "fetch", "key": key})
+        return resp.get("blob")
+
+    def get(self, key):
+        resp, _ = self.call({"v": 1, "op": "get", "key": key})
+        return resp.get("data")
+
+    def push(self, key, data, tag):
+        resp, _ = self.call({"v": 1, "op": "put", "key": key, "tag": tag}, data)
+        return resp.get("ok")

@@ -1,0 +1,49 @@
+"""Fixture: server half of a wire transport that violates SNAP010/013."""
+
+from torchsnapshot_tpu import wire
+
+
+def fingerprint(data):
+    return len(data)
+
+
+class Store:
+    def __init__(self):
+        self.blobs = {}
+
+    def put_replica(self, key, data):
+        self.blobs[key] = data
+
+
+class BadServer:
+    def __init__(self):
+        self.store = Store()
+
+    async def handle_conn(self, reader, writer):
+        while True:
+            header, payload = await wire.recv_frame(reader)
+            response, blob = await self.handle(header, payload)
+            await wire.send_frame(writer, response, blob)
+
+    async def handle(self, header, payload):
+        op = header.get("op")
+        nonce = header.get("nonce")
+        if op == "get":
+            data = self.store.blobs.get(header.get("key"), b"")
+            return {"v": 1, "ok": True, "data": nonce}, data
+        if op == "put":
+            return self._do_put(header, payload), b""
+        if op == "stale":
+            return {"v": 1, "ok": True}, b""
+        return {"v": 1, "ok": False, "error": "bad_request"}, b""
+
+    def _do_put(self, header, payload):
+        key = header.get("key")
+        self.store.put_replica(key, payload)
+        if fingerprint(payload) != header.get("tag"):
+            return {"v": 1, "ok": False, "error": "corrupt_push"}
+        return {"v": 1, "ok": True}
+
+    async def ack_then_store(self, header, payload, writer):
+        await wire.send_frame(writer, {"v": 1, "ok": True}, b"")
+        self.store.put_replica(header.get("key"), payload)

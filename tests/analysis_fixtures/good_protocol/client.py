@@ -1,0 +1,57 @@
+"""Fixture: client half of a wire transport that satisfies SNAP010-013."""
+
+import asyncio
+import random
+import time
+
+from torchsnapshot_tpu import wire
+
+WIRE_OPS = {
+    "get": {"handler": "_do_get", "retry": "budget"},
+    "put": {"handler": "_do_put", "retry": "budget"},
+    "ping": {"handler": "_do_ping", "retry": "probe"},
+}
+
+IDEMPOTENT_OPS = frozenset(WIRE_OPS)
+
+_rng = random.Random(0x5EED)
+
+
+class GoodClient:
+    def __init__(self, host, port):
+        self.host = host
+        self.port = port
+
+    async def _rpc(self, doc, payload, deadline_s):
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), deadline_s
+        )
+        await asyncio.wait_for(
+            wire.send_frame(writer, doc, payload), deadline_s
+        )
+        return await asyncio.wait_for(wire.recv_frame(reader), deadline_s)
+
+    def call(self, header, payload=b"", budget_s=30.0):
+        start = time.monotonic()
+        delay = 0.05
+        while True:
+            try:
+                return asyncio.run(self._rpc(header, payload, 5.0))
+            except OSError:
+                delay = _rng.uniform(0.05, max(0.05, delay * 3.0))
+                if time.monotonic() - start + delay > budget_s:
+                    raise
+                time.sleep(delay)
+
+    def get(self, key):
+        resp, _ = self.call({"v": 1, "op": "get", "key": key})
+        return resp.get("data")
+
+    def put(self, key, data, tag):
+        doc = {"v": 1, "op": "put", "key": key, "tag": tag}
+        resp, _ = self.call(doc, data)
+        return resp.get("stored")
+
+    def ping(self):
+        resp, _ = self.call({"v": 1, "op": "ping"})
+        return resp.get("ok")

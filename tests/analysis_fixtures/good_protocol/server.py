@@ -1,0 +1,46 @@
+"""Fixture: server half of a wire transport that satisfies SNAP010-013."""
+
+from torchsnapshot_tpu import wire
+
+from .client import WIRE_OPS
+
+
+def _fingerprint(data):
+    return len(data)
+
+
+class GoodServer:
+    def __init__(self):
+        self.blobs = {}
+        self.tags = {}
+
+    async def handle_conn(self, reader, writer):
+        while True:
+            header, payload = await wire.recv_frame(reader)
+            response, blob = self._dispatch(header, payload)
+            await wire.send_frame(writer, response, blob)
+
+    def _dispatch(self, header, payload):
+        meta = WIRE_OPS.get(header.get("op"))
+        if meta is None:
+            return {"v": 1, "ok": False, "error": "bad_request"}, b""
+        handler = getattr(self, meta["handler"])
+        return handler(header, payload)
+
+    def _do_get(self, header, payload):
+        data = self.blobs.get(header.get("key"), b"")
+        return {"v": 1, "ok": True, "data": len(data)}, data
+
+    def _do_put(self, header, payload):
+        stored_tag = _fingerprint(payload)
+        if stored_tag != header.get("tag"):
+            return {"v": 1, "ok": False, "error": "corrupt_push"}, b""
+        self.put_replica(header.get("key"), payload, stored_tag)
+        return {"v": 1, "ok": True, "stored": True}, b""
+
+    def _do_ping(self, header, payload):
+        return {"v": 1, "ok": True}, b""
+
+    def put_replica(self, key, data, tag):
+        self.blobs[key] = data
+        self.tags[key] = tag

@@ -24,6 +24,7 @@ import pytest
 
 from torchsnapshot_tpu import analysis
 from torchsnapshot_tpu.analysis import (
+    AckOrderingRule,
     BlockingSyncRule,
     ContextPropagationRule,
     ContractDriftRule,
@@ -32,7 +33,10 @@ from torchsnapshot_tpu.analysis import (
     EventLoopBlockingRule,
     LifecycleRule,
     LocksetRule,
+    RetryIdempotencyRule,
+    RpcConformanceRule,
     SwallowedExceptionRule,
+    UnboundedWireWaitRule,
 )
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -74,7 +78,8 @@ def test_fixture_corpus_is_dirty():
     assert codes == {r.code for r in analysis.default_rules()}
     assert codes == {
         "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
-        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009", "SNAP010",
+        "SNAP011", "SNAP012", "SNAP013",
     }
 
 
@@ -576,11 +581,124 @@ def test_baseline_fingerprint_survives_line_drift():
     assert r1.fingerprints[0] == r2.fingerprints[0]
 
 
+# ------------------------------------------------- SNAP010 rpc-conformance
+
+
+def test_rpc_conformance_positive_client():
+    result = analyze(
+        "bad_protocol/client.py", [RpcConformanceRule()]
+    )
+    assert findings(result) == [
+        ("SNAP010", 29),  # op 'fetch' has no server handler
+        ("SNAP010", 30),  # response field 'blob' never written
+    ]
+
+
+def test_rpc_conformance_positive_server():
+    result = analyze(
+        "bad_protocol/server.py", [RpcConformanceRule()]
+    )
+    assert findings(result) == [
+        ("SNAP010", 30),  # request field 'nonce' never sent
+        ("SNAP010", 36),  # dead handler: op 'stale'
+    ]
+
+
+def test_rpc_conformance_negative():
+    for path in ("good_protocol/client.py", "good_protocol/server.py"):
+        assert findings(analyze(path, [RpcConformanceRule()])) == []
+
+
+def test_rpc_conformance_clean_on_real_transports():
+    # The three real wire stacks are the rule's whole reason to exist;
+    # each client/server pair must be conformant end to end.
+    for rel in (
+        "snapserve/client.py",
+        "snapserve/server.py",
+        "hottier/transport.py",
+        "hottier/peer.py",
+    ):
+        result = analysis.run(
+            [os.path.join(PACKAGE, rel)], [RpcConformanceRule()]
+        )
+        assert findings(result) == [], rel
+
+
+# ---------------------------------------------- SNAP011 unbounded-wire-wait
+
+
+def test_unbounded_wire_wait_positive():
+    result = analyze(
+        "bad_protocol/client.py", [UnboundedWireWaitRule()]
+    )
+    assert findings(result) == [
+        ("SNAP011", 17),  # raw open_connection
+        ("SNAP011", 18),  # raw send_frame
+        ("SNAP011", 19),  # raw recv_frame
+    ]
+
+
+def test_unbounded_wire_wait_negative():
+    # Good client wraps every wait in wait_for; the bad SERVER is also
+    # clean — a responder legitimately blocks on the next request and
+    # replies on a connection the client is actively reading.
+    for path in (
+        "good_protocol/client.py",
+        "good_protocol/server.py",
+        "bad_protocol/server.py",
+    ):
+        assert findings(analyze(path, [UnboundedWireWaitRule()])) == []
+
+
+# ----------------------------------------------- SNAP012 retry-idempotency
+
+
+def test_retry_idempotency_positive():
+    result = analyze(
+        "bad_protocol/client.py", [RetryIdempotencyRule()]
+    )
+    assert findings(result) == [
+        ("SNAP012", 22),  # while True retry with no budget
+        ("SNAP012", 26),  # fixed 1s backoff, no jitter
+        ("SNAP012", 29),  # op 'fetch' retried but not idempotent
+    ]
+
+
+def test_retry_idempotency_negative():
+    # Jittered, budgeted, every retried op declared IDEMPOTENT_OPS.
+    assert (
+        findings(analyze("good_protocol/client.py", [RetryIdempotencyRule()]))
+        == []
+    )
+
+
+# --------------------------------------------------- SNAP013 ack-ordering
+
+
+def test_ack_ordering_positive():
+    result = analyze(
+        "bad_protocol/server.py", [AckOrderingRule()]
+    )
+    assert findings(result) == [
+        ("SNAP013", 42),  # store before fingerprint verification
+        ("SNAP013", 48),  # ok=true acked before the store
+        ("SNAP013", 49),  # stores + acks with no verification at all
+    ]
+
+
+def test_ack_ordering_negative():
+    # verify -> store -> ack on every path.
+    assert (
+        findings(analyze("good_protocol/server.py", [AckOrderingRule()]))
+        == []
+    )
+
+
 # --------------------------------------------------------------- rule registry
 
 
 def test_select_rules():
-    assert len(analysis.select_rules(None)) == 9
+    assert len(analysis.select_rules(None)) == 13
     by_name = analysis.select_rules(["blocking-sync", "lockset"])
     assert sorted(r.code for r in by_name) == ["SNAP001", "SNAP005"]
     by_code = analysis.select_rules(["SNAP002"])
@@ -590,6 +708,12 @@ def test_select_rules():
     )
     assert sorted(r.code for r in flow) == [
         "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+    ]
+    proto = analysis.select_rules(
+        ["rpc-conformance", "SNAP011", "retry-idempotency", "SNAP013"]
+    )
+    assert sorted(r.code for r in proto) == [
+        "SNAP010", "SNAP011", "SNAP012", "SNAP013",
     ]
     with pytest.raises(ValueError, match="Unknown rule"):
         analysis.select_rules(["no-such-rule"])
@@ -654,7 +778,8 @@ def test_cli_dirty_on_fixture_corpus_json():
     codes = {v["code"] for v in doc["violations"]}
     assert codes == {
         "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
-        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009", "SNAP010",
+        "SNAP011", "SNAP012", "SNAP013",
     }
     sample = doc["violations"][0]
     # Machine-readable contract: rule id, stable code, location, message.
@@ -692,7 +817,8 @@ def test_cli_list_rules():
     assert proc.returncode == 0
     for code in (
         "SNAP001", "SNAP002", "SNAP003", "SNAP004", "SNAP005",
-        "SNAP006", "SNAP007", "SNAP008", "SNAP009",
+        "SNAP006", "SNAP007", "SNAP008", "SNAP009", "SNAP010",
+        "SNAP011", "SNAP012", "SNAP013",
     ):
         assert code in proc.stdout
 

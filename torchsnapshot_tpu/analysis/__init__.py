@@ -4,7 +4,10 @@ An AST-based, pluggable lint framework encoding this framework's own
 safety invariants as CI-gated rules (see ``docs/ANALYSIS.md``).
 SNAP001-005 are syntactic; SNAP006-008 are flow-sensitive (statement-
 level CFGs + forward dataflow, ``cfg.py``/``dataflow.py``); SNAP009 is
-cross-artifact (code vs ``docs/``):
+cross-artifact (code vs ``docs/``); SNAP010-013 are wire-protocol
+conformance over the models extracted by ``protocol.py``
+(``rules_protocol.py`` — snapproto, the gate for the data-plane
+unification):
 
 ==========  =====================  ==========================================
 Code        Rule                   Invariant
@@ -27,6 +30,15 @@ SNAP008     context-propagation    contextvar readers in submitted
 SNAP009     contract-drift         env knobs / metrics / doctor rules /
                                    ledger fields / fault kinds stay in
                                    sync with their docs
+SNAP010     rpc-conformance        every client-sent op has a server
+                                   handler, no dead handlers, no frame
+                                   field skew across a transport pair
+SNAP011     unbounded-wire-wait    initiator dial/send/recv always under
+                                   an asyncio.wait_for deadline
+SNAP012     retry-idempotency      retried ops declared IDEMPOTENT_OPS;
+                                   retry loops jittered and budgeted
+SNAP013     ack-ordering           verify fingerprint before store,
+                                   store before positive ack (ack-at-k)
 ==========  =====================  ==========================================
 
 Run it::
@@ -69,6 +81,12 @@ from .rules_eventloop import EventLoopBlockingRule
 from .rules_exceptions import SwallowedExceptionRule
 from .rules_lifecycle import LifecycleRule
 from .rules_lockset import LocksetRule
+from .rules_protocol import (
+    AckOrderingRule,
+    RetryIdempotencyRule,
+    RpcConformanceRule,
+    UnboundedWireWaitRule,
+)
 
 
 def default_rules() -> List[Rule]:
@@ -83,6 +101,10 @@ def default_rules() -> List[Rule]:
         EventLoopBlockingRule(),
         ContextPropagationRule(),
         ContractDriftRule(),
+        RpcConformanceRule(),
+        UnboundedWireWaitRule(),
+        RetryIdempotencyRule(),
+        AckOrderingRule(),
     ]
 
 
@@ -104,6 +126,7 @@ def select_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
 
 
 __all__ = [
+    "AckOrderingRule",
     "BlockingSyncRule",
     "ContextPropagationRule",
     "ContractDriftRule",
@@ -114,9 +137,12 @@ __all__ = [
     "FileResult",
     "LifecycleRule",
     "LocksetRule",
+    "RetryIdempotencyRule",
+    "RpcConformanceRule",
     "Rule",
     "RunResult",
     "SwallowedExceptionRule",
+    "UnboundedWireWaitRule",
     "analyze_file",
     "analyze_source",
     "default_rules",

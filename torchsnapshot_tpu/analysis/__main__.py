@@ -141,11 +141,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="Print the rule registry and exit",
     )
+    parser.add_argument(
+        "--inventory",
+        action="store_true",
+        help=(
+            "Emit the wire-protocol inventory (ops, handlers, frame "
+            "fields, retry classes, error kinds for every transport) "
+            "and exit: markdown by default (docs/PROTOCOL.md is this, "
+            "verbatim), JSON with --format json"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in default_rules():
             print(f"{rule.code}  {rule.name}\n    {rule.description}")
+        return 0
+
+    if args.inventory:
+        from .protocol import build_inventory, render_markdown
+
+        inventory = build_inventory()
+        if args.format == "json":
+            print(json.dumps(inventory, indent=2, sort_keys=True))
+        else:
+            print(render_markdown(inventory), end="")
         return 0
 
     try:

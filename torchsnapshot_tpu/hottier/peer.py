@@ -43,6 +43,13 @@ from .. import wire
 from ..utils.env import env_int
 from . import tier
 
+# The op registry is the client module's single source of truth
+# (transport.py defines the protocol; this server half answers it).
+# Importing it here is cycle-free: transport never imports peer — the
+# two halves meet only over the wire (and in start_local_peer's lazy
+# connect_peer import).
+from .transport import HOT_TIER_OPS
+
 logger = logging.getLogger(__name__)
 
 _SPAWN_TIMEOUT_S = 120.0
@@ -214,42 +221,12 @@ class PeerServer:
         header: Dict[str, Any],
         payload: bytes,
     ) -> Tuple[Dict[str, Any], bytes]:
-        try:
-            if op == "put":
-                return self._do_put(header, payload)
-            if op == "get":
-                return self._do_get(header)
-            if op == "query":
-                return self._do_query(header)
-            if op == "drop":
-                tier.drop_replica(str(header.get("key")), self.host_id)
-                return {**base, "ok": True}, b""
-            if op == "mark_drained":
-                tier.mark_drained(
-                    str(header.get("key")), header.get("tag")
-                )
-                return {**base, "ok": True}, b""
-            if op == "drop_stale":
-                return self._do_drop_stale(header)
-            if op == "stats":
-                occ = tier.host_occupancy().get(self.host_id) or {
-                    "alive": True,
-                    "used_bytes": 0,
-                    "capacity_bytes": self.capacity_bytes,
-                    "objects": 0,
-                    "undrained_bytes": 0,
-                }
-                return {**base, "ok": True, "occupancy": occ}, b""
-            if op == "ping":
-                return (
-                    {
-                        **base,
-                        "ok": True,
-                        "host": self.host_id,
-                        "generation": self.generation,
-                    },
-                    b"",
-                )
+        # Table-driven off the shared registry: the ops this server
+        # answers ARE the ops the client may send, by construction —
+        # adding one means adding a ``_do_*`` method AND a registry row,
+        # and snapcheck's SNAP010 fails the build if either half drifts.
+        meta = HOT_TIER_OPS.get(op) if isinstance(op, str) else None
+        if meta is None:
             return (
                 {
                     **base,
@@ -261,6 +238,9 @@ class PeerServer:
                 },
                 b"",
             )
+        try:
+            handler = getattr(self, meta["handler"])
+            return handler(header, payload)
         except Exception as e:
             return (
                 {**base, "ok": False, "error": wire.error_to_wire(e)},
@@ -369,7 +349,7 @@ class PeerServer:
         )
 
     def _do_get(
-        self, header: Dict[str, Any]
+        self, header: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
         base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
         key = str(header.get("key"))
@@ -397,7 +377,7 @@ class PeerServer:
         )
 
     def _do_query(
-        self, header: Dict[str, Any]
+        self, header: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
         base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
         key = str(header.get("key"))
@@ -419,7 +399,7 @@ class PeerServer:
         )
 
     def _do_drop_stale(
-        self, header: Dict[str, Any]
+        self, header: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
         base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
         key = str(header.get("key"))
@@ -432,6 +412,47 @@ class PeerServer:
             return {**base, "ok": True, "dropped": False}, b""
         tier.drop_replica(key, self.host_id)
         return {**base, "ok": True, "dropped": True}, b""
+
+    def _do_drop(
+        self, header: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
+        tier.drop_replica(str(header.get("key")), self.host_id)
+        return {**base, "ok": True}, b""
+
+    def _do_mark_drained(
+        self, header: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
+        tier.mark_drained(str(header.get("key")), header.get("tag"))
+        return {**base, "ok": True}, b""
+
+    def _do_stats(
+        self, header: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
+        occ = tier.host_occupancy().get(self.host_id) or {
+            "alive": True,
+            "used_bytes": 0,
+            "capacity_bytes": self.capacity_bytes,
+            "objects": 0,
+            "undrained_bytes": 0,
+        }
+        return {**base, "ok": True, "occupancy": occ}, b""
+
+    def _do_ping(
+        self, header: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
+        return (
+            {
+                **base,
+                "ok": True,
+                "host": self.host_id,
+                "generation": self.generation,
+            },
+            b"",
+        )
 
 
 # ------------------------------------------------- in-process / subprocess

@@ -113,6 +113,42 @@ _WIRE_ERRORS = (
 )
 
 
+# ------------------------------------------------------ snapwire op registry
+#
+# The single source of truth for the snapwire protocol: every op kind
+# the client may put on the wire, the peer-server handler method that
+# answers it, and the per-op policy (retry shape, idempotency). Runtime
+# dispatch (peer.PeerServer._dispatch) and the static protocol checker
+# (analysis/protocol.py, rules SNAP010/SNAP012) both read THIS dict, so
+# a kind string cannot drift between client and server — an op added
+# here without a matching ``_do_*`` method (or vice versa) is a lint
+# failure before it is a runtime bad_request.
+#
+# ``retry``: "budget" ops go through the full decorrelated-jitter retry
+# stack in ``_call``; "best_effort" ops try once and fail fast;
+# "probe" is the un-retried liveness ping. Every op is idempotent by
+# construction (put re-stores the same verified bytes under the same
+# tag), which is what makes blind retry after an ambiguous failure
+# safe — SNAP012 enforces that any op reaching the retry loop is
+# declared in IDEMPOTENT_OPS below.
+HOT_TIER_OPS: Dict[str, Dict[str, Any]] = {
+    "put": {"handler": "_do_put", "retry": "budget"},
+    "get": {"handler": "_do_get", "retry": "budget"},
+    "query": {"handler": "_do_query", "retry": "budget"},
+    "drop": {"handler": "_do_drop", "retry": "best_effort"},
+    "mark_drained": {"handler": "_do_mark_drained", "retry": "best_effort"},
+    "drop_stale": {"handler": "_do_drop_stale", "retry": "best_effort"},
+    "stats": {"handler": "_do_stats", "retry": "budget"},
+    "ping": {"handler": "_do_ping", "retry": "probe"},
+}
+
+# Ops that may be blindly re-sent after an ambiguous transport failure
+# (the attempt may or may not have reached the peer). All of snapwire
+# qualifies; the registry exists so the next non-idempotent op must
+# make that decision explicitly.
+IDEMPOTENT_OPS = frozenset(HOT_TIER_OPS)
+
+
 class _WireFailure(Exception):
     """One RPC attempt failed at the transport level; retryable."""
 
@@ -533,6 +569,11 @@ class RemotePeer:
     def _call_once(
         self, header: Dict[str, Any], payload: bytes, deadline_s: float
     ) -> Tuple[Dict[str, Any], bytes]:
+        op = header.get("op")
+        if op not in HOT_TIER_OPS:
+            # Programming error, not a wire condition: never retried,
+            # never sent — the registry is the protocol.
+            raise ValueError(f"unknown snapwire op {op!r}")
         if self._killed:
             raise HostLostError(
                 f"peer host {self.host_id} ({self.addr_str}) is dead"

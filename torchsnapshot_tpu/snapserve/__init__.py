@@ -42,6 +42,7 @@ from .cache import ByteLRU, content_fingerprint
 from .client import (
     SnapServePlugin,
     parse_snapserve_url,
+    ping_server,
     restore_stats_begin,
     restore_stats_collect,
     stats_snapshot,
@@ -65,6 +66,7 @@ __all__ = [
     "fetch_server_stats",
     "kill_local_servers",
     "parse_snapserve_url",
+    "ping_server",
     "restore_stats_begin",
     "restore_stats_collect",
     "start_local_server",

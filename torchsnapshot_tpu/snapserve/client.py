@@ -205,6 +205,35 @@ def restore_stats_collect(token: Any) -> Optional[Dict[str, Any]]:
     return summary
 
 
+def ping_server(addr: str, timeout_s: float = 10.0) -> Dict[str, Any]:
+    """One-shot ``ping`` RPC: the liveness probe for smoke scripts,
+    doctor checks, and tests. Returns the response header (``server``
+    names the service answering); raises on an unreachable or
+    non-snapserve endpoint. Every wire wait — dial, send, recv — is
+    bounded by ``timeout_s``."""
+
+    async def _ping() -> Dict[str, Any]:
+        host, _, port = addr.rpartition(":")
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), timeout_s
+        )
+        try:
+            await asyncio.wait_for(
+                send_frame(
+                    writer, {"v": PROTOCOL_VERSION, "op": "ping", "id": 0}
+                ),
+                timeout_s,
+            )
+            header, _ = await asyncio.wait_for(recv_frame(reader), timeout_s)
+            if not header.get("ok"):
+                raise RuntimeError(f"ping RPC failed: {header!r}")
+            return header
+        finally:
+            writer.close()
+
+    return asyncio.run(_ping())
+
+
 class SnapServePlugin(StoragePlugin):
     """Storage plugin speaking to a snapserve server, with direct
     backend fallback. Resolved by ``url_to_storage_plugin`` for
@@ -350,7 +379,14 @@ class SnapServePlugin(StoragePlugin):
         if trace_id is not None or flow_id is not None:
             header_doc["trace"] = {"id": trace_id, "flow": flow_id}
         try:
-            await send_frame(writer, header_doc)
+            # The send is deadline-bounded like the recv: a server that
+            # accepts the dial but stops reading (wedged event loop,
+            # full socket buffer) must degrade to the direct-read
+            # fallback instead of hanging the restore (snapcheck
+            # SNAP011).
+            await asyncio.wait_for(
+                send_frame(writer, header_doc), timeout_s
+            )
             header, payload = await asyncio.wait_for(
                 recv_frame(reader), timeout_s
             )

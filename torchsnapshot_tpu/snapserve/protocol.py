@@ -44,10 +44,34 @@ from ..wire import (  # noqa: F401  (re-exported protocol surface)
     wire_to_error,
 )
 
+# ---------------------------------------------------- read-plane op registry
+#
+# The single source of truth for the snapserve protocol: every op kind
+# a client may send and the server handler method that answers it.
+# Runtime dispatch (server.SnapServer._handle_request) and the static
+# protocol checker (analysis/protocol.py, rules SNAP010/SNAP012) both
+# read THIS dict, so a kind string cannot drift between client and
+# server. The read plane is read-only by construction — every op is a
+# pure read, hence idempotent; the client's recovery policy is
+# fallback-to-direct-backend rather than retry, recorded per op as
+# ``retry``.
+READ_PLANE_OPS = {
+    "read": {"handler": "_op_read", "retry": "fallback"},
+    "stats": {"handler": "_op_stats", "retry": "none"},
+    "ping": {"handler": "_op_ping", "retry": "none"},
+}
+
+# Ops safe to re-send after an ambiguous transport failure. All
+# read-plane ops qualify (pure reads); the registry exists so the next
+# non-idempotent op must make that decision explicitly.
+IDEMPOTENT_OPS = frozenset(READ_PLANE_OPS)
+
 __all__ = [
     "MAX_HEADER_BYTES",
     "MAX_PAYLOAD_BYTES",
     "PROTOCOL_VERSION",
+    "IDEMPOTENT_OPS",
+    "READ_PLANE_OPS",
     "InvalidRange",
     "ProtocolError",
     "RemoteServerError",

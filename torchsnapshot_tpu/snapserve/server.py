@@ -53,6 +53,7 @@ from ..utils.env import env_float, env_int
 from .cache import ByteLRU, content_fingerprint
 from .protocol import (
     PROTOCOL_VERSION,
+    READ_PLANE_OPS,
     ProtocolError,
     error_to_wire,
     recv_frame,
@@ -813,49 +814,14 @@ class SnapServer:
         op = header.get("op")
         payload = b""
         response: Dict[str, Any] = {"v": PROTOCOL_VERSION, "id": req_id}
-        # snapxray causal context from the frame: the client's trace id
-        # is adopted for everything this request does (every span below
-        # stamps it), and the flow step is the server half of the
-        # client's Perfetto arrow. Malformed context never fails a read.
-        wire_trace = header.get("trace")
-        if not isinstance(wire_trace, dict):
-            wire_trace = {}
-        trace_id = wire_trace.get("id")
-        flow_id = wire_trace.get("flow")
+        # Table-driven off the shared registry (.protocol): the ops this
+        # server answers ARE the ops a client may send, by construction
+        # — adding one means adding an ``_op_*`` method AND a registry
+        # row, and snapcheck's SNAP010 fails the build if either half
+        # drifts.
+        meta = READ_PLANE_OPS.get(op) if isinstance(op, str) else None
         try:
-            if op == "read":
-                byte_range = header.get("range")
-                with tracing.adopt_trace(
-                    trace_id if isinstance(trace_id, str) else None
-                ):
-                    tracing.flow_step(
-                        "snapserve.rpc",
-                        flow_id if isinstance(flow_id, str) else None,
-                        path=str(header.get("path", "")),
-                    )
-                    with tracing.span(
-                        "snapserve.request",
-                        path=str(header.get("path", "")),
-                        client=client,
-                    ):
-                        payload, meta = await self.service.handle_read(
-                            str(header.get("backend", "")),
-                            str(header.get("path", "")),
-                            tuple(byte_range) if byte_range else None,
-                            client=client,
-                        )
-                response.update(ok=True, **meta)
-            elif op == "stats":
-                telemetry.counter(
-                    _metric_names.SNAPSERVE_REQUESTS, op="stats"
-                ).inc()
-                response.update(ok=True, stats=self.service.stats())
-            elif op == "ping":
-                telemetry.counter(
-                    _metric_names.SNAPSERVE_REQUESTS, op="ping"
-                ).inc()
-                response.update(ok=True, server="snapserve")
-            else:
+            if meta is None:
                 response.update(
                     ok=False,
                     error={
@@ -863,6 +829,10 @@ class SnapServer:
                         "message": f"unknown op {op!r}",
                     },
                 )
+            else:
+                handler = getattr(self, meta["handler"])
+                updates, payload = await handler(header, client)
+                response.update(ok=True, **updates)
         except asyncio.CancelledError:
             raise
         except BaseException as e:
@@ -877,6 +847,62 @@ class SnapServer:
                 await send_frame(writer, response, payload)
         finally:
             await gate.release(len(payload))
+
+    # ------------------------------------------------------------ op handlers
+    #
+    # One method per READ_PLANE_OPS row, uniform signature
+    # ``(header, client) -> (response_updates, payload_bytes)``; the
+    # dispatcher stamps ``ok=True`` and marshals exceptions.
+
+    async def _op_read(
+        self, header: Dict[str, Any], client: str
+    ) -> Tuple[Dict[str, Any], bytes]:
+        byte_range = header.get("range")
+        # snapxray causal context from the frame: the client's trace id
+        # is adopted for everything this request does (every span below
+        # stamps it), and the flow step is the server half of the
+        # client's Perfetto arrow. Malformed context never fails a read.
+        wire_trace = header.get("trace")
+        if not isinstance(wire_trace, dict):
+            wire_trace = {}
+        trace_id = wire_trace.get("id")
+        flow_id = wire_trace.get("flow")
+        with tracing.adopt_trace(
+            trace_id if isinstance(trace_id, str) else None
+        ):
+            tracing.flow_step(
+                "snapserve.rpc",
+                flow_id if isinstance(flow_id, str) else None,
+                path=str(header.get("path", "")),
+            )
+            with tracing.span(
+                "snapserve.request",
+                path=str(header.get("path", "")),
+                client=client,
+            ):
+                payload, meta = await self.service.handle_read(
+                    str(header.get("backend", "")),
+                    str(header.get("path", "")),
+                    tuple(byte_range) if byte_range else None,
+                    client=client,
+                )
+        return meta, payload
+
+    async def _op_stats(
+        self, header: Dict[str, Any], client: str
+    ) -> Tuple[Dict[str, Any], bytes]:
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REQUESTS, op="stats"
+        ).inc()
+        return {"stats": self.service.stats()}, b""
+
+    async def _op_ping(
+        self, header: Dict[str, Any], client: str
+    ) -> Tuple[Dict[str, Any], bytes]:
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REQUESTS, op="ping"
+        ).inc()
+        return {"server": "snapserve"}, b""
 
 
 # ------------------------------------------------- in-process server registry
@@ -961,8 +987,15 @@ def fetch_server_stats(addr: str, timeout_s: float = 10.0) -> Dict[str, Any]:
             asyncio.open_connection(host, int(port)), timeout_s
         )
         try:
-            await send_frame(
-                writer, {"v": PROTOCOL_VERSION, "op": "stats", "id": 0}
+            # The send is deadline-bounded like the dial and the recv: a
+            # peer that stops reading (full socket buffer, wedged accept
+            # loop) must not hang this one-shot helper forever
+            # (snapcheck SNAP011).
+            await asyncio.wait_for(
+                send_frame(
+                    writer, {"v": PROTOCOL_VERSION, "op": "stats", "id": 0}
+                ),
+                timeout_s,
             )
             header, _ = await asyncio.wait_for(recv_frame(reader), timeout_s)
             if not header.get("ok"):
