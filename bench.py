@@ -204,6 +204,7 @@ _POST_RESTORE_SECTION_FLOORS = [
     ("wire", 60.0),
     ("repair", 45.0),
     ("read_fanout", 75.0),
+    ("fleet", 60.0),
     ("step_stall", 90.0),
 ]
 
@@ -311,6 +312,7 @@ def _summary_doc() -> dict:
         "every_step": r.get("every_step"),
         "wire": r.get("wire"),
         "read_fanout": r.get("read_fanout"),
+        "fleet": r.get("fleet"),
         "scaling": r.get("scaling"),
         "sharded_cpu": r.get("sharded_cpu"),
         "gaps": r.get("gaps", []),
@@ -1600,6 +1602,286 @@ def run_read_fanout_block(
         )
 
 
+def run_fleet_block(
+    payload_bytes: int = 8 << 20,
+    n_servers: int = 3,
+    n_clients: int = 32,
+    modeled_backend_gbps: float = 0.2,
+    fairness_quota_bytes: int = 1 << 20,
+) -> dict:
+    """Snapfleet: N snapserve servers behind one consistent-hash ring,
+    32 differently-sharded clients, one shared modeled object-store
+    egress. Two certified quantities (ISSUE-17):
+
+    - **Pushdown + sharding**: each client asks the fleet to ``plan``
+      its OWN shard slice of one chunk-stored array and fetches only
+      the returned chunk records through the ring. Per-client fetched
+      bytes must be ≈ its shard fraction (max client ≤ 2x ideal — a
+      client re-fetching the whole object is THE pushdown regression),
+      and aggregate backend amplification (backend bytes / stored
+      payload) ≤ 1.2x: content-keyed routing gives every chunk ONE
+      owner, so 32 clients cost ~1x backend work.
+    - **Tenant fairness**: against one quota-limited server, a
+      saturating tenant must queue behind its OWN quota (deferrals > 0)
+      while a small tenant's occasional reads are granted immediately —
+      the small tenant's server-side grant-wait p95 stays a small
+      fraction of the saturating tenant's
+      (``fleet.fairness_p95_ratio``).
+
+    Host-only numpy payloads, in-process servers — tenancy-independent.
+    """
+    import asyncio as _asyncio
+    import uuid as _uuid
+
+    import numpy as np
+
+    from torchsnapshot_tpu import StateDict, snapserve
+    from torchsnapshot_tpu.chunkstore import (
+        chunk_object_path,
+        store_url_for,
+    )
+    from torchsnapshot_tpu.io_types import IOReq
+    from torchsnapshot_tpu.snapserve import pushdown
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    import torchsnapshot_tpu.storage_plugin as _sp_mod
+
+    root = f"memory://bench-fleet-{_uuid.uuid4().hex[:10]}/snap"
+    # Small chunks so every client's shard spans several records; rows
+    # divide evenly into n_clients shards so the C-order byte hulls tile
+    # the payload exactly.
+    rows = n_clients * 8
+    cols = max(64, payload_bytes // (4 * rows))
+    rng = np.random.default_rng(23)
+    reference = rng.standard_normal((rows, cols)).astype(np.float32)
+    prev_chunk_bytes = os.environ.get("TPUSNAPSHOT_CHUNK_BYTES")
+    os.environ["TPUSNAPSHOT_CHUNK_BYTES"] = str(64 << 10)
+    try:
+        snap = Snapshot.take(
+            root, {"model": StateDict(w=reference)}, chunks=True
+        )
+    finally:
+        if prev_chunk_bytes is None:
+            os.environ.pop("TPUSNAPSHOT_CHUNK_BYTES", None)
+        else:
+            os.environ["TPUSNAPSHOT_CHUNK_BYTES"] = prev_chunk_bytes
+    entry = next(
+        e
+        for e in snap.get_manifest().values()
+        if getattr(e, "chunks", None)
+    )
+    records = entry.chunks
+    # Chunk objects live in the run-shared .chunkstore sibling, not
+    # under the snapshot root — that store is the backend the fleet
+    # fronts here.
+    store_root = store_url_for(root)
+    record_sizes = [int(r["n"]) for r in records]
+    total_stored = sum(record_sizes)
+    itemsize = 4
+
+    shared = {
+        "lock": threading.Lock(),
+        "avail_at": 0.0,
+        "rate": modeled_backend_gbps * 1024**3,
+        "bytes": 0,
+    }
+    fleet = snapserve.start_local_fleet(
+        n=n_servers,
+        service_factory=lambda: snapserve.ReadService(
+            backend_resolver=lambda url: _SharedRateReadThrottle(
+                url_to_storage_plugin(url), shared
+            ),
+        ),
+    )
+    stats_before = snapserve.stats_snapshot()
+    client_bytes = [0] * n_clients
+    plan_mismatches: list = []
+    errors: list = []
+
+    def _one(idx: int) -> None:
+        try:
+            lo = idx * (rows // n_clients)
+            hi = (idx + 1) * (rows // n_clients)
+            doc = {
+                "shape": [rows, cols],
+                "itemsize": itemsize,
+                "record_sizes": record_sizes,
+                "boxes": [[[lo, hi], [0, cols]]],
+            }
+            remote = snapserve.plan_remote(
+                fleet.addrs[idx % n_servers], doc
+            )
+            local = pushdown.plan_from_doc(doc)
+            if list(remote.get("indices") or []) != list(local["indices"]):
+                plan_mismatches.append(
+                    {"client": idx, "remote": remote, "local": local}
+                )
+                return
+            plugin = snapserve.SnapServePlugin(
+                f"{fleet.addr_spec}/{store_root}"
+            )
+            try:
+
+                async def _fetch() -> int:
+                    got = 0
+                    for i in local["indices"]:
+                        req = IOReq(path=chunk_object_path(records[i]["k"]))
+                        await plugin.read(req)
+                        got += len(req.data)
+                    return got
+
+                client_bytes[idx] = _asyncio.run(_fetch())
+            finally:
+                plugin.close()
+        except Exception as e:  # surfaced via `errors` below
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=_one, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    begin = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.monotonic() - begin
+    fleet.stop()
+    stats_after = snapserve.stats_snapshot()
+    fallbacks = (
+        stats_after["fallback_objects"] - stats_before["fallback_objects"]
+    )
+    failovers = (
+        stats_after["failover_objects"] - stats_before["failover_objects"]
+    )
+
+    ideal_fraction = 1.0 / n_clients
+    fractions = [b / total_stored for b in client_bytes]
+    max_fraction = max(fractions) if fractions else 1.0
+    amplification = round(shared["bytes"] / total_stored, 3)
+    shard_ok = bool(
+        not errors
+        and not plan_mismatches
+        and all(b > 0 for b in client_bytes)
+        and max_fraction <= 2.0 * ideal_fraction
+    )
+    meets_amp = amplification <= 1.2
+
+    # ------------------------------------------------- tenant fairness
+    # One quota-limited server; a saturating tenant hammers it from 8
+    # threads while a small tenant issues occasional reads. The quota is
+    # SMALLER than one chunk response, so each saturating response is
+    # admitted alone (tenant-idle oversize grant) and that tenant's
+    # concurrent requests serialize behind their own quota — deferrals
+    # with measurable waits — while the small tenant's sequential reads
+    # always find their own in-flight at zero and grant immediately.
+    # The server's per-tenant grant-wait p95s are the verdict.
+    fair: dict = {"ok": False}
+    server = snapserve.start_local_server(
+        tenant_quota_bytes=fairness_quota_bytes
+    )
+    try:
+        paths = [chunk_object_path(r["k"]) for r in records]
+        # The saturating tenant reads a blob LARGER than its quota (and
+        # than the socket buffers): each response is admitted alone
+        # while its siblings park on the deferred-grant queue — the
+        # serialization whose grant waits the p95 measures. The small
+        # tenant's sequential chunk reads always find their own
+        # in-flight at zero and grant immediately (0-wait samples).
+        blob = b"\xa5" * (4 << 20)
+        backend = url_to_storage_plugin(store_root)
+        try:
+            _asyncio.run(
+                backend.write(IOReq(path="fairblob", data=blob))
+            )
+        finally:
+            backend.close()
+
+        def _tenant_reads(
+            tenant: str, path_list, n_reads: int, out_err: list
+        ) -> None:
+            plugin = snapserve.SnapServePlugin(
+                f"{server.addr}/{store_root}"
+            )
+            plugin.tenant_override = tenant
+            try:
+
+                async def _go() -> None:
+                    for j in range(n_reads):
+                        req = IOReq(path=path_list[j % len(path_list)])
+                        await plugin.read(req)
+
+                _asyncio.run(_go())
+            except Exception as e:
+                out_err.append(repr(e))
+            finally:
+                plugin.close()
+
+        fair_errors: list = []
+        sat_threads = [
+            threading.Thread(
+                target=_tenant_reads,
+                args=("saturating", ["fairblob"], 6, fair_errors),
+                daemon=True,
+            )
+            for _ in range(8)
+        ]
+        small_thread = threading.Thread(
+            target=_tenant_reads,
+            args=("small", paths, 8, fair_errors),
+            daemon=True,
+        )
+        for t in sat_threads:
+            t.start()
+        time.sleep(0.05)  # let the saturating tenant fill its quota
+        small_thread.start()
+        for t in sat_threads + [small_thread]:
+            t.join(timeout=300)
+        tenants = snapserve.fetch_server_stats(server.addr).get(
+            "tenants", {}
+        )
+        sat = tenants.get("saturating") or {}
+        small = tenants.get("small") or {}
+        sat_p95 = float(sat.get("grant_wait_p95_s") or 0.0)
+        small_p95 = float(small.get("grant_wait_p95_s") or 0.0)
+        ratio = round(small_p95 / max(sat_p95, 1e-9), 4)
+        fair = {
+            "ok": bool(
+                not fair_errors
+                and int(sat.get("deferrals") or 0) > 0
+                and (small_p95 <= 0.25 * sat_p95 or small_p95 < 0.005)
+            ),
+            "quota_bytes": fairness_quota_bytes,
+            "saturating": sat,
+            "small": small,
+            "p95_ratio": ratio,
+            "errors": fair_errors[:3],
+        }
+    finally:
+        server.stop()
+        _sp_mod._MEMORY_STORES.pop(
+            root.split("://", 1)[1].split("/", 1)[0], None
+        )
+
+    return {
+        "ok": bool(shard_ok and meets_amp and fair["ok"]),
+        "bytes": total_stored,
+        "n_servers": n_servers,
+        "n_clients": n_clients,
+        "wall_s": round(wall, 3),
+        "records": len(records),
+        "per_client_fraction_max": round(max_fraction, 4),
+        "per_client_fraction_ideal": round(ideal_fraction, 4),
+        "amplification": amplification,
+        "meets_1_2x": meets_amp,
+        "failovers": failovers,
+        "fallbacks": fallbacks,
+        "plan_mismatches": plan_mismatches[:3],
+        "errors": errors[:3],
+        "fairness": fair,
+        "fairness_p95_ratio": fair.get("p95_ratio"),
+    }
+
+
 def _floor_bytes() -> int:
     return int(os.environ.get("TPUSNAPSHOT_BENCH_FLOOR_BYTES", 1 << 30))
 
@@ -2535,6 +2817,31 @@ def _bench_body(bench_dir: str) -> None:
             _section_done("read_fanout")
         print(
             f"[bench] read_fanout: {_RESULTS['read_fanout']}",
+            file=sys.stderr,
+        )
+
+        # Snapfleet: N servers behind one consistent-hash ring, 32
+        # differently-sharded clients with chunk pushdown, plus the
+        # quota-limited tenant-fairness case. Certifies aggregate
+        # amplification <= 1.2x and the small tenant's grant-wait p95.
+        _phase("read-plane fleet (snapfleet)")
+        if not _section_gate("fleet"):
+            _RESULTS["fleet"] = {
+                "ok": False,
+                "skipped": "deadline",
+                "error": "skipped: hard deadline",
+            }
+            _note_gap(
+                "fleet", "remaining budget below the section floor"
+            )
+        else:
+            try:
+                _RESULTS["fleet"] = run_fleet_block()
+            except Exception as e:
+                _RESULTS["fleet"] = {"ok": False, "error": repr(e)}
+            _section_done("fleet")
+        print(
+            f"[bench] fleet: {_RESULTS['fleet']}",
             file=sys.stderr,
         )
 

@@ -35,6 +35,12 @@ Compared metrics:
   service's whole point is holding this at ~1x), LOWER is better
 - ``read_fanout.served_gbps`` (aggregate client throughput through the
   service at the largest fan-out), higher is better
+- ``fleet.amplification`` (aggregate backend amplification across the
+  consistent-hashed snapserve fleet with chunk pushdown), LOWER is
+  better
+- ``fleet.fairness_p95_ratio`` (small tenant's grant-wait p95 over the
+  saturating tenant's under a shared quota-limited server), LOWER is
+  better
 
 Uncertified numbers (``restore_uncertified``/``degraded``) are compared
 but flagged in the output — a gate wired to flaky numbers should see
@@ -73,6 +79,14 @@ _METRICS: List[Tuple[str, str, str]] = [
     ("every_step.hot.overhead_pct", "every-step ovh %", "low"),
     ("read_fanout.amplification_served", "fanout amplification", "low"),
     ("read_fanout.served_gbps", "fanout GB/s", "high"),
+    # Snapfleet (bench fleet section): aggregate backend amplification
+    # across the consistent-hashed fleet with chunk pushdown — a rise
+    # means clients re-fetching whole objects or the ring duplicating
+    # owners; the tenant-fairness p95 ratio (small tenant's grant-wait
+    # p95 over the saturating tenant's) rising means the small tenant
+    # is queueing behind the big one's backlog.
+    ("fleet.amplification", "fleet amplification", "low"),
+    ("fleet.fairness_p95_ratio", "fleet fairness p95 ratio", "low"),
     # Snapwire (bench wire section): replication across real peer
     # processes. The unchanged-retake delta ratio (wire bytes /
     # payload bytes) is THE dedup-on-the-wire certificate — a rise
@@ -406,6 +420,42 @@ def _self_test() -> int:
     assert reg and "fanout GB/s" in reg[0], f"GB/s halving must fail: {reg}"
     _, reg = compare(base, fanout, 0.2)
     assert not reg, f"fanout keys absent on one side are skipped: {reg}"
+    # Snapfleet keys: both lower-is-better — amplification creeping up
+    # means pushdown/ring sharding stopped saving backend bytes; the
+    # fairness p95 ratio rising means the small tenant started queueing
+    # behind the saturating one. A 0.0 ratio baseline (the small tenant
+    # never waited at all) is skipped like any non-positive baseline.
+    fleet = dict(
+        base,
+        fleet={"amplification": 1.0, "fairness_p95_ratio": 0.1},
+    )
+    _, reg = compare(fleet, dict(fleet), 0.2)
+    assert not reg, f"identical fleet runs must pass: {reg}"
+    worse_fleet_amp = dict(
+        fleet,
+        fleet={"amplification": 1.5, "fairness_p95_ratio": 0.1},
+    )
+    _, reg = compare(fleet, worse_fleet_amp, 0.2)
+    assert reg and "fleet amplification" in reg[0], (
+        f"fleet 1.5x amp must fail: {reg}"
+    )
+    worse_fairness = dict(
+        fleet,
+        fleet={"amplification": 1.0, "fairness_p95_ratio": 0.9},
+    )
+    _, reg = compare(fleet, worse_fairness, 0.2)
+    assert reg and "fairness" in reg[0], (
+        f"fairness ratio 9x must fail: {reg}"
+    )
+    zero_ratio = dict(
+        fleet, fleet={"amplification": 1.0, "fairness_p95_ratio": 0.0}
+    )
+    _, reg = compare(zero_ratio, worse_fairness, 0.2)
+    assert not reg or all("fairness" not in r for r in reg), (
+        f"0.0 ratio baseline must be skipped: {reg}"
+    )
+    _, reg = compare(base, fleet, 0.2)
+    assert not reg, f"fleet keys absent on one side are skipped: {reg}"
     # Dedup/codec keys: physical percentages and the codec ratio are
     # lower-is-better (a RISE is the regression); effective GB/s is
     # higher-is-better like every throughput.

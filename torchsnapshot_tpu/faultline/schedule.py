@@ -54,6 +54,13 @@ Fault kinds:
   (``snapserve.kill_local_servers``): sockets abort, the listening
   port closes, and the client under test must degrade to direct
   backend reads (counted, bit-exact — the read plane's contract).
+- **fleet member faults** (``kill_fleet_member`` / ``slow_fleet_member``)
+  — the surgical snapfleet variants: ONE named in-process member (from
+  ``snapserve.fleet.start_local_fleet``) dies or turns slow at a
+  deterministic ``snapserve.request`` boundary. A kill must surface as
+  client-side ring-replica failover (never an error, never a direct
+  fallback while replicas live); a slow member as hung-not-dead to the
+  fleet supervisor.
 
 The schedule is deterministic by construction: rules fire on the *n*-th
 match of their (op-glob, path-glob) pattern, and the crash point on a
@@ -121,6 +128,7 @@ class FaultRule:
 
     kind: str  # "transient" | "permanent" | "torn" | "latency" | "crash"
     #          | "hostloss" | "killserver"
+    #          | "killmember" | "slowmember"  (snapfleet: one NAMED member)
     #          | "drop_conn" | "torn_frame" | "slow_wire"  (snapwire)
     #          | "flap"  (snapmend: lose-then-revive churn)
     op: str = "*"
@@ -132,6 +140,7 @@ class FaultRule:
     torn: Optional[TornWrite] = None
     error_factory: Optional[Callable[[str, str], Exception]] = None
     host: Optional[int] = None  # hostloss: which peer host dies
+    member: Optional[str] = None  # killmember/slowmember: fleet member name
     # flap: how many further op boundaries after the loss until the
     # host comes back (a wire-backed peer as a FRESH subprocess one
     # membership generation up; an in-process host empty).
@@ -283,6 +292,60 @@ class FaultSchedule:
         self.rules.append(
             FaultRule(
                 kind="killserver", op=op, path=path, nth=nth, times=1
+            )
+        )
+        return self
+
+    def kill_fleet_member(
+        self,
+        member: str,
+        op: str = "snapserve.request",
+        path: str = "*",
+        nth: int = 1,
+    ) -> "FaultSchedule":
+        """Snapfleet: kill ONE named in-process fleet member (e.g.
+        ``"m1"`` from :func:`~torchsnapshot_tpu.snapserve.fleet.
+        start_local_fleet`) at the ``nth`` matching op boundary —
+        ``kill_server`` made surgical. The boundary fires BEFORE the
+        RPC dials, so the matched read already finds the member dead;
+        the client's ring-replica failover (never an error, never a
+        direct fallback while replicas live) is the behavior under
+        test."""
+        self.rules.append(
+            FaultRule(
+                kind="killmember",
+                op=op,
+                path=path,
+                nth=nth,
+                times=1,
+                member=member,
+            )
+        )
+        return self
+
+    def slow_fleet_member(
+        self,
+        member: str,
+        seconds: float = 0.05,
+        op: str = "snapserve.request",
+        path: str = "*",
+        nth: int = 1,
+    ) -> "FaultSchedule":
+        """Snapfleet: inject ``seconds`` of per-request latency into ONE
+        named fleet member's server loop (every request it answers from
+        then on pays it) — the slow-but-alive member scenario. The
+        supervisor must classify it hung-not-dead (strikes, no
+        immediate down), and clients keep getting correct bytes,
+        slower."""
+        self.rules.append(
+            FaultRule(
+                kind="slowmember",
+                op=op,
+                path=path,
+                nth=nth,
+                times=1,
+                member=member,
+                seconds=seconds,
             )
         )
         return self
@@ -588,6 +651,20 @@ class FaultController:
                     # this lock), so the very op this boundary guards
                     # already finds the server dead.
                     kill_local_servers()
+                    continue
+                if rule.kind == "killmember":
+                    self._record(idx, op, path, "killmember")
+                    from ..snapserve import fleet
+
+                    fleet.kill_local_member(rule.member or "")
+                    continue
+                if rule.kind == "slowmember":
+                    self._record(idx, op, path, "slowmember")
+                    from ..snapserve import fleet
+
+                    fleet.slow_local_member(
+                        rule.member or "", rule.seconds
+                    )
                     continue
                 if rule.kind == "crash":
                     self.crashed = True

@@ -44,8 +44,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import staging_pool, tracing
+from . import staging_pool, telemetry, tracing
 from .telemetry import consume_profile as _cprof
+from .telemetry import metrics as _metric_names
 from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from .utils.env import env_int
 from .ops.transfer import (
@@ -1132,21 +1133,35 @@ class _ContentChunksReadState(_PooledAssemblyState):
         records: List[Dict[str, Any]],
         dtype_name: str,
         store_base: Optional[int],
+        selected: Optional[List[int]] = None,
     ) -> None:
         super().__init__(sum(int(r["n"]) for r in records))
         self._inner = inner
         self._records = records
         self._dtype_name = dtype_name
         self._store_base = store_base
-        self._remaining = len(records)
+        # Chunk pushdown (snapfleet): when set, only these record
+        # indices are fetched — the rest of the assembly buffer stays
+        # unwritten, which is safe because the scatter only ever reads
+        # the slice boxes whose byte hulls selected these records
+        # (pushdown.select_records). Offsets stay the ORIGINAL
+        # cumulative offsets so selected bytes land where the scatter
+        # expects them.
+        self._selected = (
+            list(range(len(records))) if selected is None else selected
+        )
+        self._remaining = len(self._selected)
 
     def build_reads(self) -> List[ReadReq]:
         from .chunkstore import chunk_object_path
         from .storage_plugin import make_ref_location
 
+        offsets = [0]
+        for rec in self._records:
+            offsets.append(offsets[-1] + int(rec["n"]))
         reqs: List[ReadReq] = []
-        offset = 0
-        for i, rec in enumerate(self._records):
+        for j, i in enumerate(self._selected):
+            rec = self._records[i]
             path = chunk_object_path(rec["k"])
             if self._store_base is not None:
                 path = make_ref_location(self._store_base, path)
@@ -1154,11 +1169,10 @@ class _ContentChunksReadState(_PooledAssemblyState):
                 ReadReq(
                     path=path,
                     buffer_consumer=_ContentChunkConsumer(
-                        self, rec, offset, first=(i == 0)
+                        self, rec, offsets[i], first=(j == 0)
                     ),
                 )
             )
-            offset += int(rec["n"])
         return reqs
 
     async def absorb(
@@ -1540,11 +1554,48 @@ class ArrayRestorePlan:
                     region_notify=self._note_region_copy,
                 )
                 n_logical += 1
+                # Chunk pushdown: when this process's target slices
+                # cover only part of the stored object (a differently-
+                # meshed restore), cut the record list to those whose
+                # byte ranges intersect the slices' C-order byte hulls
+                # — each client fetches ≈ its shard fraction instead of
+                # the whole object. Conservative (hull ⊇ strided
+                # footprint) and disabled under strict integrity (the
+                # skipped records can't be verified if never read).
+                selected = None
+                if (
+                    not strict
+                    and os.environ.get("TPUSNAPSHOT_CHUNK_PUSHDOWN")
+                    != "0"
+                ):
+                    from .snapserve import pushdown
+
+                    sizes = [int(r["n"]) for r in content]
+                    sel = pushdown.select_records(
+                        sizes,
+                        pushdown.needed_intervals(
+                            tuple(chunk_sz),
+                            [
+                                tuple(
+                                    (sl.start, sl.stop)
+                                    for sl in ov.chunk_slices
+                                )
+                                for _r, _rs, ov in copies
+                            ],
+                            itemsize,
+                        ),
+                    )
+                    if 0 < len(sel.indices) < len(content):
+                        selected = sel.indices
+                        telemetry.counter(
+                            _metric_names.CHUNK_PUSHDOWN_SKIPPED_BYTES
+                        ).inc(sum(sizes) - sel.selected_bytes)
                 state = _ContentChunksReadState(
                     inner,
                     content,
                     dtype_name=aentry.dtype,
                     store_base=getattr(aentry, "base", None),
+                    selected=selected,
                 )
                 reqs.extend(state.build_reads())
                 continue

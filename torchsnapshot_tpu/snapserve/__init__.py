@@ -32,20 +32,50 @@ Three pieces:
   unchanged over the service; the server address comes from the
   constructor or ``TPUSNAPSHOT_SNAPSERVE_ADDR``.
 
+- **Fleet** (:mod:`.fleet`, snapfleet) — N servers behind one URL
+  (``snapserve://h1:p1,h2:p2,.../<backend>`` or
+  ``TPUSNAPSHOT_SNAPSERVE_FLEET_ADDRS``): a consistent-hash ring over
+  chunk content keys shards the fleet's aggregate cache (one owner per
+  object), clients fail over owner → ring replicas → direct backend
+  (counted, bit-exact, never an error), and a generation-stamped
+  membership doc with snapmend-style supervision (hung ≠ dead, stale
+  generations refused) tracks the members. Chunk pushdown (the ``plan``
+  op + the local cut in io_preparer) lets a differently-meshed restore
+  fetch ≈ its shard fraction per client; per-tenant admission
+  (``TPUSNAPSHOT_SNAPSERVE_TENANT`` /
+  ``TPUSNAPSHOT_SNAPSERVE_TENANT_QUOTA_BYTES``) keeps one saturating
+  tenant from starving the rest — over-quota responses are DELAYED,
+  never failed.
+
 Fault injection: the client announces every RPC attempt as a
 ``snapserve.request`` storage-op boundary, so faultline schedules can
-``kill_server()`` / ``slow_server()`` deterministically mid-restore
-(docs/FAULTS.md).
+``kill_server()`` / ``slow_server()`` — or the surgical
+``kill_fleet_member(name)`` / ``slow_fleet_member(name, seconds)`` —
+deterministically mid-restore (docs/FAULTS.md).
 """
 
 from .cache import ByteLRU, content_fingerprint
 from .client import (
     SnapServePlugin,
+    fetch_member_info,
     parse_snapserve_url,
     ping_server,
+    plan_remote,
     restore_stats_begin,
     restore_stats_collect,
     stats_snapshot,
+)
+from .fleet import (
+    FleetMembership,
+    FleetSupervisor,
+    FleetView,
+    HashRing,
+    LocalFleet,
+    StaleGenerationError,
+    kill_local_member,
+    routing_key,
+    slow_local_member,
+    start_local_fleet,
 )
 from .remote import RemoteSnapshot
 from .server import (
@@ -58,17 +88,29 @@ from .server import (
 
 __all__ = [
     "ByteLRU",
+    "FleetMembership",
+    "FleetSupervisor",
+    "FleetView",
+    "HashRing",
+    "LocalFleet",
     "ReadService",
     "RemoteSnapshot",
     "SnapServePlugin",
     "SnapServer",
+    "StaleGenerationError",
     "content_fingerprint",
+    "fetch_member_info",
     "fetch_server_stats",
+    "kill_local_member",
     "kill_local_servers",
     "parse_snapserve_url",
     "ping_server",
+    "plan_remote",
     "restore_stats_begin",
     "restore_stats_collect",
+    "routing_key",
+    "slow_local_member",
+    "start_local_fleet",
     "start_local_server",
     "stats_snapshot",
 ]

@@ -59,6 +59,15 @@ READ_PLANE_OPS = {
     "read": {"handler": "_op_read", "retry": "fallback"},
     "stats": {"handler": "_op_stats", "retry": "none"},
     "ping": {"handler": "_op_ping", "retry": "none"},
+    # Chunk pushdown: the request payload carries the record layout +
+    # slice boxes (pushdown.plan_from_doc), the response the record
+    # subset to fetch. Pure compute — no backend touch — and recoverable
+    # by local computation (the client holds the same math), hence
+    # retry "fallback".
+    "plan": {"handler": "_op_plan", "retry": "fallback"},
+    # Fleet membership probe: the member's name + generation stamp
+    # (snapfleet supervision; a stale generation is refused upstream).
+    "membership": {"handler": "_op_membership", "retry": "none"},
 }
 
 # Ops safe to re-send after an ambiguous transport failure. All

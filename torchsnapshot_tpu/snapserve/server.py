@@ -35,12 +35,24 @@ holds all the read-plane smarts:
   default 256 MiB); a client that stops draining stalls only itself.
 
 The server is read-only by construction: the only ops it understands
-are ``read``, ``stats``, and ``ping``. Writes, deletes, and sweeps go
-from clients straight to the backend.
+are ``read``, ``stats``, ``ping``, ``plan`` (chunk pushdown — pure
+compute over the request document, :mod:`.pushdown`), and
+``membership`` (the fleet supervision probe, :mod:`.fleet`). Writes,
+deletes, and sweeps go from clients straight to the backend.
+
+Multi-tenant admission layers on the per-client flow control: every
+request carries a tenant id (client knob
+``TPUSNAPSHOT_SNAPSERVE_TENANT``), per-tenant in-flight response bytes
+are bounded by ``TPUSNAPSHOT_SNAPSERVE_TENANT_QUOTA_BYTES`` (0 =
+unlimited), and over-quota requests park for a DEFERRED GRANT — never
+an error — dequeued weighted-fair (smallest in-flight tenant first),
+so a saturating tenant queues behind its own quota while a small
+tenant's requests keep flowing.
 """
 
 import argparse
 import asyncio
+import collections
 import logging
 import threading
 import time
@@ -68,6 +80,10 @@ META_TTL_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_META_TTL_S"
 _DEFAULT_META_TTL_S = 15.0
 CLIENT_INFLIGHT_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_CLIENT_INFLIGHT_BYTES"
 _DEFAULT_CLIENT_INFLIGHT_BYTES = 256 << 20
+TENANT_QUOTA_ENV_VAR = "TPUSNAPSHOT_SNAPSERVE_TENANT_QUOTA_BYTES"
+_DEFAULT_TENANT_QUOTA_BYTES = 0  # 0 = unlimited (admission disabled)
+# Bounded per-tenant grant-wait sample window for the p95 in stats().
+_TENANT_WAIT_SAMPLES = 512
 # Per-connection concurrent request cap: flow control bounds bytes; this
 # bounds task count so a client cannot fork unbounded handler tasks with
 # zero-byte requests.
@@ -140,6 +156,172 @@ class _ClientGate:
         async with self._cond:
             self._outstanding -= nbytes
             self._cond.notify_all()
+
+
+class TenantAdmission:
+    """Per-tenant in-flight-byte quotas over the whole transport.
+
+    Layered ON TOP of :class:`_ClientGate` (which bounds one
+    connection): a tenant's total in-flight response bytes across every
+    connection are bounded by the quota. Over-quota requests are parked
+    as futures — a DEFERRED GRANT, never an error — and dequeued
+    weighted-fair when bytes release: tenants with the smallest
+    in-flight go first (FIFO within a tenant), so a saturating tenant
+    queues behind its own quota while a small tenant's occasional
+    requests are granted immediately. A single response larger than the
+    whole quota is admitted alone when its tenant is otherwise idle —
+    the same progress guarantee the client gate makes.
+
+    Quota 0 disables admission (accounting still runs; ``stats()``
+    reports per-tenant traffic either way).
+    """
+
+    def __init__(self, quota_bytes: int) -> None:
+        self._quota = max(0, int(quota_bytes))
+        self._inflight: Dict[str, int] = {}
+        self._waiters: Dict[str, List[Tuple[int, "asyncio.Future"]]] = {}
+        # Stats are read by stats() from other threads; all waiter and
+        # in-flight mutation happens on the server loop, but one lock
+        # keeps every access uniform (holds are short). Reentrant so
+        # the pump helper can assert the guard it needs even when the
+        # caller already holds it.
+        self._lock = threading.RLock()
+        self._tenant_stats: Dict[str, Dict[str, Any]] = (
+            collections.defaultdict(
+                lambda: {
+                    "requests": 0,
+                    "egress_bytes": 0,
+                    "deferrals": 0,
+                    "waits": [],
+                }
+            )
+        )
+
+    def _tstats(self, tenant: str) -> Dict[str, Any]:
+        # Lock held by caller; the defaultdict materializes the entry.
+        return self._tenant_stats[tenant]
+
+    def _admissible(self, tenant: str, nbytes: int) -> bool:
+        # Lock held by caller.
+        cur = self._inflight.get(tenant, 0)
+        return cur == 0 or cur + nbytes <= self._quota
+
+    async def acquire(self, tenant: str, nbytes: int) -> None:
+        with self._lock:
+            st = self._tstats(tenant)
+            st["requests"] += 1
+            st["egress_bytes"] += nbytes
+            if self._quota <= 0 or self._admissible(tenant, nbytes):
+                self._inflight[tenant] = (
+                    self._inflight.get(tenant, 0) + nbytes
+                )
+                # Immediate grants count as 0-wait samples so a
+                # never-deferred tenant has a defined grant-wait p95
+                # (the fairness bench compares tenants' p95s).
+                samples = st["waits"]
+                samples.append(0.0)
+                if len(samples) > _TENANT_WAIT_SAMPLES:
+                    del samples[0]
+                return
+            st["deferrals"] += 1
+            fut: "asyncio.Future" = (
+                asyncio.get_running_loop().create_future()
+            )
+            self._waiters.setdefault(tenant, []).append((nbytes, fut))
+        telemetry.counter(
+            _metric_names.SNAPSERVE_TENANT_DEFERRALS
+        ).inc()
+        begin = time.monotonic()
+        try:
+            await fut
+        except asyncio.CancelledError:
+            grants: List["asyncio.Future"] = []
+            with self._lock:
+                queue = self._waiters.get(tenant, [])
+                if (nbytes, fut) in queue:
+                    queue.remove((nbytes, fut))
+                elif fut.done() and not fut.cancelled():
+                    # Granted concurrently with the cancellation: the
+                    # bytes were charged — give them back and let the
+                    # grant flow to the next waiter.
+                    self._inflight[tenant] = max(
+                        0, self._inflight.get(tenant, 0) - nbytes
+                    )
+                    grants = self._pump_locked()
+            for g in grants:
+                if not g.done():
+                    g.set_result(None)
+            raise
+        waited = time.monotonic() - begin
+        telemetry.counter(
+            _metric_names.SNAPSERVE_TENANT_GRANT_WAIT_SECONDS
+        ).inc(waited)
+        with self._lock:
+            samples = self._tstats(tenant)["waits"]
+            samples.append(waited)
+            if len(samples) > _TENANT_WAIT_SAMPLES:
+                del samples[0]
+
+    def release(self, tenant: str, nbytes: int) -> None:
+        with self._lock:
+            self._inflight[tenant] = max(
+                0, self._inflight.get(tenant, 0) - nbytes
+            )
+            grants = self._pump_locked()
+        for fut in grants:
+            if not fut.done():
+                fut.set_result(None)
+
+    def _pump_locked(self) -> List["asyncio.Future"]:
+        """Grant every waiting head that now fits, smallest-in-flight
+        tenant first. Each tenant's queue is FIFO and blocks only on
+        its OWN quota — one tenant's oversize head never heads-of-line
+        another tenant."""
+        granted: List["asyncio.Future"] = []
+        # Callers hold the (reentrant) lock; taking it here keeps the
+        # mutation guarded even if a future call site forgets.
+        with self._lock:
+            while True:
+                progressed = False
+                tenants = sorted(
+                    (t for t, q in self._waiters.items() if q),
+                    key=lambda t: (self._inflight.get(t, 0), t),
+                )
+                for tenant in tenants:
+                    queue = self._waiters[tenant]
+                    while queue and queue[0][1].cancelled():
+                        queue.pop(0)
+                    if not queue:
+                        continue
+                    nbytes, fut = queue[0]
+                    if self._admissible(tenant, nbytes):
+                        queue.pop(0)
+                        self._inflight[tenant] = (
+                            self._inflight.get(tenant, 0) + nbytes
+                        )
+                        granted.append(fut)
+                        progressed = True
+                if not progressed:
+                    return granted
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for tenant, st in self._tenant_stats.items():
+                waits = sorted(st["waits"])
+                p95 = (
+                    waits[min(len(waits) - 1, int(len(waits) * 0.95))]
+                    if waits
+                    else 0.0
+                )
+                out[tenant] = {
+                    "requests": st["requests"],
+                    "egress_bytes": st["egress_bytes"],
+                    "deferrals": st["deferrals"],
+                    "inflight_bytes": self._inflight.get(tenant, 0),
+                    "grant_wait_p95_s": round(p95, 6),
+                }
+            return out
 
 
 class ReadService:
@@ -656,10 +838,26 @@ class SnapServer:
         service: Optional[ReadService] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        member_name: Optional[str] = None,
+        generation: int = 0,
+        tenant_quota_bytes: Optional[int] = None,
     ) -> None:
         self.service = service if service is not None else ReadService()
         self._host = host
         self._port = port
+        # Fleet identity (snapfleet): the name + generation stamp the
+        # ``membership`` op answers with. A respawned member comes back
+        # one generation up; the fleet supervisor refuses stale ones.
+        self.member_name = member_name
+        self.generation = int(generation)
+        if tenant_quota_bytes is None:
+            tenant_quota_bytes = env_int(
+                TENANT_QUOTA_ENV_VAR, _DEFAULT_TENANT_QUOTA_BYTES
+            )
+        self._tenants = TenantAdmission(tenant_quota_bytes)
+        # faultline slow_fleet_member: a per-request injected delay — a
+        # hung-not-dead member, without touching the backend path.
+        self._injected_delay = 0.0
         self.addr: Optional[str] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -693,6 +891,12 @@ class SnapServer:
         assert self._server is not None
         async with self._server:
             await self._server.serve_forever()
+
+    def set_injected_delay(self, seconds: float) -> None:
+        """Arm a per-request delay (faultline ``slow_fleet_member``):
+        every request answered from now on sleeps ``seconds`` first."""
+        with self._lock:
+            self._injected_delay = max(0.0, float(seconds))
 
     def kill(self, timeout_s: float = 5.0) -> None:
         """Abrupt death: close the listening socket and every live
@@ -757,7 +961,7 @@ class SnapServer:
         try:
             while True:
                 try:
-                    header, _payload = await recv_frame(reader)
+                    header, req_payload = await recv_frame(reader)
                 except (
                     asyncio.IncompleteReadError,
                     ConnectionError,
@@ -773,7 +977,8 @@ class SnapServer:
                 await task_slots.acquire()
                 task = asyncio.ensure_future(
                     self._handle_request(
-                        header, writer, write_lock, gate, client
+                        header, req_payload, writer, write_lock, gate,
+                        client,
                     )
                 )
                 tasks.add(task)
@@ -805,6 +1010,7 @@ class SnapServer:
     async def _handle_request(
         self,
         header: Dict[str, Any],
+        req_payload: bytes,
         writer: asyncio.StreamWriter,
         write_lock: asyncio.Lock,
         gate: _ClientGate,
@@ -812,8 +1018,12 @@ class SnapServer:
     ) -> None:
         req_id = header.get("id")
         op = header.get("op")
+        tenant = str(header.get("tenant") or "default")
         payload = b""
         response: Dict[str, Any] = {"v": PROTOCOL_VERSION, "id": req_id}
+        if self._injected_delay > 0:
+            # faultline slow_fleet_member: a hung member answers, late.
+            await asyncio.sleep(self._injected_delay)
         # Table-driven off the shared registry (.protocol): the ops this
         # server answers ARE the ops a client may send, by construction
         # — adding one means adding an ``_op_*`` method AND a registry
@@ -831,7 +1041,9 @@ class SnapServer:
                 )
             else:
                 handler = getattr(self, meta["handler"])
-                updates, payload = await handler(header, client)
+                updates, payload = await handler(
+                    header, req_payload, client
+                )
                 response.update(ok=True, **updates)
         except asyncio.CancelledError:
             raise
@@ -841,21 +1053,30 @@ class SnapServer:
             # test); the client sees a backend error. Real crashes of
             # the server itself are modeled by kill_server.
             response.update(ok=False, error=error_to_wire(e))
-        await gate.acquire(len(payload))
+        # Admission order: tenant quota (fleet-wide fairness) outside,
+        # per-connection flow control inside — a tenant over ITS quota
+        # parks here without holding connection-gate capacity.
+        await self._tenants.acquire(tenant, len(payload))
         try:
-            async with write_lock:
-                await send_frame(writer, response, payload)
+            await gate.acquire(len(payload))
+            try:
+                async with write_lock:
+                    await send_frame(writer, response, payload)
+            finally:
+                await gate.release(len(payload))
         finally:
-            await gate.release(len(payload))
+            self._tenants.release(tenant, len(payload))
 
     # ------------------------------------------------------------ op handlers
     #
     # One method per READ_PLANE_OPS row, uniform signature
-    # ``(header, client) -> (response_updates, payload_bytes)``; the
-    # dispatcher stamps ``ok=True`` and marshals exceptions.
+    # ``(header, req_payload, client) -> (response_updates,
+    # payload_bytes)``; the dispatcher stamps ``ok=True`` and marshals
+    # exceptions. ``req_payload`` is the request frame's raw payload
+    # (only ``plan`` carries one today).
 
     async def _op_read(
-        self, header: Dict[str, Any], client: str
+        self, header: Dict[str, Any], req_payload: bytes, client: str
     ) -> Tuple[Dict[str, Any], bytes]:
         byte_range = header.get("range")
         # snapxray causal context from the frame: the client's trace id
@@ -889,20 +1110,66 @@ class SnapServer:
         return meta, payload
 
     async def _op_stats(
-        self, header: Dict[str, Any], client: str
+        self, header: Dict[str, Any], req_payload: bytes, client: str
     ) -> Tuple[Dict[str, Any], bytes]:
         telemetry.counter(
             _metric_names.SNAPSERVE_REQUESTS, op="stats"
         ).inc()
-        return {"stats": self.service.stats()}, b""
+        stats = self.service.stats()
+        stats["tenants"] = self._tenants.stats()
+        return {"stats": stats}, b""
 
     async def _op_ping(
-        self, header: Dict[str, Any], client: str
+        self, header: Dict[str, Any], req_payload: bytes, client: str
     ) -> Tuple[Dict[str, Any], bytes]:
         telemetry.counter(
             _metric_names.SNAPSERVE_REQUESTS, op="ping"
         ).inc()
         return {"server": "snapserve"}, b""
+
+    async def _op_plan(
+        self, header: Dict[str, Any], req_payload: bytes, client: str
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """Chunk pushdown: the request payload is a JSON plan document
+        (record layout + the slice boxes this client's shard needs);
+        the answer is exactly the record subset to fetch. Pure compute
+        — shared with the client's local cut via :mod:`.pushdown`, so
+        RPC answer and local ground truth cannot drift."""
+        import json
+
+        from . import pushdown
+
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REQUESTS, op="plan"
+        ).inc()
+        try:
+            doc = (
+                json.loads(req_payload.decode("utf-8"))
+                if req_payload
+                else {}
+            )
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"malformed plan request: {e!r}") from e
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"malformed plan request: not an object: {doc!r}"
+            )
+        return {"plan": pushdown.plan_from_doc(doc)}, b""
+
+    async def _op_membership(
+        self, header: Dict[str, Any], req_payload: bytes, client: str
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """Fleet supervision probe: who am I, and which incarnation.
+        The supervisor refuses answers whose generation is older than
+        its record (a SIGCONT'd zombie of a replaced member)."""
+        telemetry.counter(
+            _metric_names.SNAPSERVE_REQUESTS, op="membership"
+        ).inc()
+        return {
+            "member": self.member_name or "",
+            "generation": self.generation,
+            "server": "snapserve",
+        }, b""
 
 
 # ------------------------------------------------- in-process server registry
@@ -935,10 +1202,21 @@ def start_local_server(
     service: Optional[ReadService] = None,
     host: str = "127.0.0.1",
     port: int = 0,
+    member_name: Optional[str] = None,
+    generation: int = 0,
+    tenant_quota_bytes: Optional[int] = None,
 ) -> SnapServer:
     """Run a server on a daemon thread; returns once the socket is
-    bound (``server.addr`` is set). The caller owns ``server.stop()``."""
-    server = SnapServer(service=service, host=host, port=port)
+    bound (``server.addr`` is set). The caller owns ``server.stop()``.
+    ``member_name``/``generation`` stamp the fleet identity the
+    ``membership`` op answers with (:func:`.fleet.start_local_fleet`
+    passes them; a lone server needs neither).
+    ``tenant_quota_bytes`` overrides the env quota (tests/bench)."""
+    server = SnapServer(
+        service=service, host=host, port=port,
+        member_name=member_name, generation=generation,
+        tenant_quota_bytes=tenant_quota_bytes,
+    )
 
     def _run() -> None:
         async def _main() -> None:

@@ -50,6 +50,10 @@ read-plane-degraded       a restore routed via snapserve fell back to
                           direct backend reads for >0 objects
                           (critical when >50% of bytes) — the read
                           service was unreachable; bit-exactness held
+fleet-degraded            a fleet-routed restore left the ring owner:
+                          failovers / owner misses (warn), or the
+                          whole fleet exhausted into direct fallback
+                          (critical); bit-exactness held either way
 durability-lag-above-     the take's ack→.tierdown window (stamped into
 budget                    the report by the hot tier's drain) exceeded
                           TPUSNAPSHOT_SLO_DURABILITY_LAG_S (default
@@ -818,6 +822,67 @@ def _rule_read_plane_degraded(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def _rule_fleet_degraded(report: Dict[str, Any]) -> Optional[Finding]:
+    """A fleet-routed restore did not get every object from its ring
+    owner: failovers (a member failed mid-read and a replica served),
+    owner misses (the owner was down-latched), or full fleet
+    exhaustion (reason 'fleet-exhausted' direct fallbacks). Bytes
+    stayed bit-exact — that is the ladder's contract — but every
+    non-owner read lands on a member whose cache does NOT shard that
+    key, duplicating cache footprint and backend egress fleet-wide.
+    Critical when the fleet was exhausted (some reads went direct);
+    warn otherwise."""
+    if report.get("kind") != "restore":
+        return None
+    planes = [
+        s.get("read_plane") for s in _ranks(report) if s.get("read_plane")
+    ]
+    if not planes:
+        return None
+    owner_misses = sum(int(p.get("owner_misses") or 0) for p in planes)
+    failover = sum(int(p.get("failover_objects") or 0) for p in planes)
+    exhausted = sum(
+        int((p.get("fallback_reasons") or {}).get("fleet-exhausted") or 0)
+        for p in planes
+    )
+    if owner_misses <= 0 and failover <= 0 and exhausted <= 0:
+        return None
+    servers: Dict[str, Dict[str, int]] = {}
+    for p in planes:
+        for addr, entry in (p.get("servers") or {}).items():
+            agg = servers.setdefault(addr, {"objects": 0, "bytes": 0})
+            agg["objects"] += int(entry.get("objects") or 0)
+            agg["bytes"] += int(entry.get("bytes") or 0)
+    return Finding(
+        rule="fleet-degraded",
+        severity="critical" if exhausted > 0 else "warn",
+        title=(
+            f"fleet-routed restore left the ring owner for "
+            f"{owner_misses + failover + exhausted} object(s) "
+            f"({failover} failover, {owner_misses} owner-miss, "
+            f"{exhausted} fleet-exhausted direct fallback)"
+        ),
+        evidence={
+            "owner_misses": owner_misses,
+            "failover_objects": failover,
+            "fleet_exhausted_fallbacks": exhausted,
+            "servers": servers,
+        },
+        remediation=(
+            "bytes stayed bit-exact (replica failover and direct "
+            "fallback are the degraded-mode contract), but non-owner "
+            "reads defeat the ring's cache sharding: each displaced "
+            "key is now cached on (and fetched by) a member that "
+            "doesn't own it. Check which members died or hung "
+            "(tpusnapshot_snapserve_fleet_probes_total{result}), "
+            "restart them — a respawn re-registers one generation up "
+            "and reclaims its ring segment automatically — and verify "
+            "TPUSNAPSHOT_SNAPSERVE_FLEET_ADDRS lists the same members "
+            "on every client."
+        ),
+    )
+
+
 # Chunking must have covered at least this much logical payload before
 # the dedup-ineffective verdict means anything (a 2 MiB toy take proves
 # nothing about chunk-grid fit).
@@ -896,6 +961,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_hot_tier_degraded,
     _rule_replication_degraded,
     _rule_read_plane_degraded,
+    _rule_fleet_degraded,
     _rule_dedup_ineffective,
 ]
 

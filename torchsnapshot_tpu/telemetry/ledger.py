@@ -89,7 +89,9 @@ source operation did not produce them::
                                          # with the hot tier enabled)
       "read_plane": {"remote_objects", "remote_bytes",
                      "fallback_objects", "fallback_bytes",
-                     "fallback_reasons": {reason: n}} | null,
+                     "fallback_reasons": {reason: n},
+                     "owner_misses"?, "failover_objects"?,
+                     "servers"?: {addr: {objects, bytes}}} | null,
                                          # snapserve attribution
                                          # (restores routed through the
                                          # read service; fallbacks =
@@ -639,6 +641,23 @@ def _read_plane_totals(
     }
     if reasons:
         out["fallback_reasons"] = reasons
+    # Snapfleet attribution: failover/owner-miss counts and the
+    # per-server byte balance (which member served how much — a skewed
+    # balance under a uniform key set is a ring or membership problem).
+    owner_misses = sum(int(p.get("owner_misses") or 0) for p in noted)
+    failover = sum(int(p.get("failover_objects") or 0) for p in noted)
+    if owner_misses:
+        out["owner_misses"] = owner_misses
+    if failover:
+        out["failover_objects"] = failover
+    servers: Dict[str, Dict[str, int]] = {}
+    for p in noted:
+        for addr, entry in (p.get("servers") or {}).items():
+            agg = servers.setdefault(addr, {"objects": 0, "bytes": 0})
+            agg["objects"] += int(entry.get("objects") or 0)
+            agg["bytes"] += int(entry.get("bytes") or 0)
+    if len(servers) > 1 or owner_misses or failover:
+        out["servers"] = servers
     return out
 
 

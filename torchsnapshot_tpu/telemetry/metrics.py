@@ -462,6 +462,36 @@ SNAPSERVE_FALLBACKS = (
     "tpusnapshot_snapserve_fallbacks_total"  # counter {reason}
 )
 
+# Read-plane fleet (snapfleet, snapserve/fleet.py) + multi-tenant
+# admission. Route outcomes: "owner" (ring owner served), "owner_miss"
+# (owner down-latched, a replica served without an attempt), "failover"
+# (owner/replica attempted and failed mid-read, the next replica
+# served), "fallback" (every member exhausted — the direct-backend
+# degradation counted per reason in SNAPSERVE_FALLBACKS too). Probe
+# results: up / hung / dead / stale (a refused stale generation).
+# Tenant deferrals are over-quota requests parked for a deferred grant
+# (never an error); grant-wait seconds accumulate the time they waited.
+# Pushdown skipped bytes are content-chunk bytes a shard-sliced restore
+# proved it did not need (io_preparer + the `plan` op share the math).
+SNAPSERVE_FLEET_ROUTES = (
+    "tpusnapshot_snapserve_fleet_routes_total"  # counter {outcome}
+)
+SNAPSERVE_FLEET_MEMBERS = (
+    "tpusnapshot_snapserve_fleet_up_members"  # gauge
+)
+SNAPSERVE_FLEET_PROBES = (
+    "tpusnapshot_snapserve_fleet_probes_total"  # counter {result}
+)
+SNAPSERVE_TENANT_DEFERRALS = (
+    "tpusnapshot_snapserve_tenant_deferrals_total"  # counter
+)
+SNAPSERVE_TENANT_GRANT_WAIT_SECONDS = (
+    "tpusnapshot_snapserve_tenant_grant_wait_seconds_total"  # counter
+)
+CHUNK_PUSHDOWN_SKIPPED_BYTES = (
+    "tpusnapshot_chunk_pushdown_skipped_bytes_total"  # counter
+)
+
 # Content-addressed chunk store (chunkstore.py) + codec stage
 # (codecs.py): chunk dedup outcomes, logical-vs-stored byte flow, and
 # GC activity. `result` on CHUNKSTORE_BYTES is "hit" (logical bytes a

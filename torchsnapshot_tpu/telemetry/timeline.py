@@ -142,6 +142,20 @@ _BENCH_METRICS: List[_MetricDef] = [
         0.05,
         0.2,
     ),
+    # snapfleet headline numbers (bench fleet section): aggregate
+    # backend amplification across the fleet (per-client pushdown must
+    # keep the SUM of fetched bytes near 1x the payload — creep means
+    # clients re-fetching whole objects), and the small tenant's p95
+    # grant-wait ratio vs the saturating tenant (fairness: the small
+    # tenant must not queue behind the big one's whole backlog).
+    ("fleet.amplification", "fleet backend amplification", "high", 0.1, 0.2),
+    (
+        "fleet.fairness_p95_ratio",
+        "fleet tenant-fairness p95 ratio",
+        "high",
+        0.1,
+        0.5,
+    ),
 ]
 
 
