@@ -1113,6 +1113,29 @@ def run_every_step_block(
             os.environ["TPUSNAPSHOT_SWEEP_MIN_AGE_S"] = prev_age
 
 
+def _wire_ops_window(token) -> dict:
+    """snapflight: close a wiretap window and shape the per-op
+    summaries for the BENCH JSON — p50/p99 latency, deadline margin,
+    misses, retries per telemetry key. bench_compare reads this to
+    note op-mix and latency shifts between runs (notes, not gates —
+    wire latency on shared CI hosts is weather, not regression)."""
+    from torchsnapshot_tpu import wiretap
+
+    out = {}
+    for key, b in sorted(wiretap.window_collect(token).items()):
+        entry = {
+            "count": int(b.get("count") or 0),
+            "p50_ms": round(float(b.get("p50_s") or 0.0) * 1000, 3),
+            "p99_ms": round(float(b.get("p99_s") or 0.0) * 1000, 3),
+            "deadline_misses": int(b.get("deadline_misses") or 0),
+            "retries": int(b.get("retries") or 0),
+        }
+        if b.get("margin_p99") is not None:
+            entry["margin_p99"] = round(float(b["margin_p99"]), 4)
+        out[key] = entry
+    return out
+
+
 def run_wire_block(
     n_steps: int = 4,
     payload_bytes: int = 4 << 20,
@@ -1132,9 +1155,12 @@ def run_wire_block(
     from torchsnapshot_tpu.hottier.peer import spawn_peer
     from torchsnapshot_tpu.telemetry import goodput
 
+    from torchsnapshot_tpu import wiretap
+
     budget_pct = float(os.environ.get("TPUSNAPSHOT_CKPT_BUDGET_PCT", 5.0))
     prev_age = os.environ.get("TPUSNAPSHOT_SWEEP_MIN_AGE_S")
     os.environ["TPUSNAPSHOT_SWEEP_MIN_AGE_S"] = "0"
+    wire_token = wiretap.window_begin()
     procs = []
     try:
         for host in (1, 2):
@@ -1207,6 +1233,7 @@ def run_wire_block(
             "retake_payload_bytes": payload_delta,
             "retake_wire_bytes": wire_delta,
             "wire": totals,
+            "wire_ops": _wire_ops_window(wire_token),
             "peers": len(procs),
         }
         import torchsnapshot_tpu.storage_plugin as _sp_mod
@@ -1645,6 +1672,9 @@ def run_fleet_block(
     from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
     import torchsnapshot_tpu.storage_plugin as _sp_mod
 
+    from torchsnapshot_tpu import wiretap
+
+    wire_token = wiretap.window_begin()
     root = f"memory://bench-fleet-{_uuid.uuid4().hex[:10]}/snap"
     # Small chunks so every client's shard spans several records; rows
     # divide evenly into n_clients shards so the C-order byte hulls tile
@@ -1879,6 +1909,7 @@ def run_fleet_block(
         "errors": errors[:3],
         "fairness": fair,
         "fairness_p95_ratio": fair.get("p95_ratio"),
+        "wire_ops": _wire_ops_window(wire_token),
     }
 
 

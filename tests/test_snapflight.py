@@ -1,0 +1,382 @@
+"""snapflight: unified wire observability across the three transports.
+
+What the suite pins:
+
+1. **wiretap core** — the shared recording layer's aggregation,
+   outcome clamping, window deltas, and quantile math (the module
+   self-test plus focused cases).
+2. **Blackbox flight recorder** — fault/degrade dumps land as
+   crc-framed ``*.blackbox.jsonl`` statusfiles; a torn final record
+   (the dumping process died mid-write) parses as a skip, never an
+   error — the ledger's torn-tail discipline.
+3. **Faultline** — a REAL SIGKILLed hot-tier peer and snapserve server
+   mid-traffic: the surviving client's blackbox dump parses and holds
+   the victim's last RPCs with trace ids and outcomes.
+4. **Doctor / SLO / ops** — an injected ``slow_wire`` /
+   ``slow_fleet_member`` deterministically trips the
+   ``deadline-margin-collapsing`` rule (report-mode and live-mode),
+   and the ops CLI's fleet wire mode aggregates member sample blocks
+   with the documented exit-code contract.
+"""
+
+import asyncio
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from torchsnapshot_tpu import snapserve, tracing, wiretap
+from torchsnapshot_tpu.hottier.peer import spawn_peer
+from torchsnapshot_tpu.hottier.transport import (
+    RemotePeer,
+    clear_wire_faults,
+    script_wire_fault,
+)
+from torchsnapshot_tpu.telemetry.doctor import (
+    diagnose_report,
+    wire_pressure_finding,
+)
+from torchsnapshot_tpu.telemetry import ops as scope_ops
+from torchsnapshot_tpu.telemetry import slo as scope_slo
+
+pytestmark = pytest.mark.faultline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_wiretap():
+    wiretap.reset()
+    clear_wire_faults()
+    yield
+    wiretap.reset()
+    clear_wire_faults()
+
+
+# ------------------------------------------------------------ wiretap core
+
+
+def test_wiretap_module_self_test():
+    wiretap._self_test()  # raises on any failed pin
+
+
+def test_record_aggregates_outcomes_and_margins():
+    wiretap.reset()
+    wiretap.record("snapwire", "put", seconds=0.010, deadline_s=1.0,
+                   bytes_out=4096)
+    wiretap.record("snapwire", "put", seconds=0.020, deadline_s=1.0,
+                   bytes_out=4096, attempt=1)
+    wiretap.record(
+        "snapwire", "put", seconds=1.5, deadline_s=1.0,
+        outcome="deadline_miss",
+    )
+    # Unknown outcomes clamp into the bounded taxonomy.
+    wiretap.record("snapwire", "put", seconds=0.01, outcome="weird-kind")
+    ops = wiretap.summary()
+    put = ops["snapwire/put"]
+    assert put["count"] == 4
+    assert put["deadline_misses"] == 1
+    assert put["retries"] == 1
+    assert put["bytes_out"] == 8192
+    assert put["outcomes"]["ok"] == 2
+    assert put["outcomes"]["deadline_miss"] == 1
+    assert put["outcomes"]["error"] == 1
+    assert "weird-kind" not in put["outcomes"]
+    # A miss consumed >= the whole budget: margin clamps at >= 1.0.
+    assert put["margin_max"] >= 1.0
+
+
+def test_window_collect_is_a_delta_not_a_total():
+    wiretap.reset()
+    wiretap.record("snapserve", "read", seconds=0.01, deadline_s=10.0)
+    token = wiretap.window_begin()
+    wiretap.record("snapserve", "read", seconds=0.03, deadline_s=10.0)
+    wiretap.record("snapserve", "read", seconds=0.05, deadline_s=10.0)
+    window = wiretap.window_collect(token)
+    assert window["snapserve/read"]["count"] == 2  # not 3
+    assert wiretap.summary()["snapserve/read"]["count"] == 3
+
+
+# --------------------------------------------------------------- blackbox
+
+
+def test_blackbox_dump_parses_and_skips_torn_tail(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_WIRETAP_DIR", str(tmp_path))
+    wiretap.reset()
+    for i in range(5):
+        wiretap.record(
+            "snapwire", "put", seconds=0.01 * (i + 1), deadline_s=2.0,
+            trace_id=f"take-{i:012x}",
+        )
+    path = wiretap.dump_blackbox("fault")
+    assert path and os.path.exists(path)
+    records, skipped = wiretap.read_blackbox(path)
+    assert skipped == 0
+    assert records[0]["kind"] == "blackbox_header"
+    assert records[0]["reason"] == "fault"
+    events = [r for r in records if "op" in r]
+    assert len(events) == 5
+    assert events[-1]["trace"] == "take-000000000004"
+    # Torn tail: chop into the final record — the crc discipline skips
+    # exactly the truncated piece and keeps everything before it.
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-9])
+    records2, skipped2 = wiretap.read_blackbox(path)
+    assert skipped2 == 1
+    assert len(records2) == len(records) - 1
+
+
+def test_note_degrade_dumps_with_mark(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_WIRETAP_DIR", str(tmp_path))
+    wiretap.reset()
+    wiretap.record("snapwire", "get", seconds=0.02, deadline_s=2.0)
+    wiretap.note_degrade("peer_down", peer="127.0.0.1:9")
+    files = glob.glob(str(tmp_path / "*.blackbox.jsonl"))
+    assert len(files) == 1
+    records, skipped = wiretap.read_blackbox(files[0])
+    assert skipped == 0
+    marks = [r for r in records if "mark" in r]
+    assert marks and marks[0]["mark"] == "peer_down"
+    assert marks[0]["peer"] == "127.0.0.1:9"
+
+
+# ------------------------------------------------- faultline: SIGKILL'd peers
+
+
+def test_sigkilled_peer_leaves_survivor_blackbox_with_trace_join(
+    tmp_path, monkeypatch
+):
+    """SIGKILL a real hot-tier peer subprocess mid-traffic: the
+    SURVIVING client process's degrade hook dumps its flight recorder,
+    and the dump holds the victim's last RPCs — ops, outcomes, and the
+    take's trace id (snapxray-joinable)."""
+    monkeypatch.setenv("TPUSNAPSHOT_WIRETAP_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S", "0.5")
+    wiretap.reset()
+    proc, addr, _none = spawn_peer(host_id=7, register=False)
+    peer = RemotePeer(host_id=7, addr=addr)
+    try:
+        from torchsnapshot_tpu.fingerprint import fingerprint_host
+
+        payload = b"z" * 2048
+        tag = fingerprint_host(payload)
+        with tracing.trace_scope("take") as trace_id:
+            for i in range(3):
+                stored, _ = peer.put(f"k{i}", payload, tag=tag,
+                                     root="memory://flight/run")
+                assert stored
+            proc.kill()
+            proc.wait(timeout=10.0)
+            assert proc.poll() == -signal.SIGKILL
+            from torchsnapshot_tpu.hottier.tier import HostLostError
+
+            with pytest.raises(HostLostError):
+                peer.put("k-dead", payload, tag=tag,
+                         root="memory://flight/run")
+    finally:
+        peer.close()
+        if proc.poll() is None:
+            proc.kill()
+    files = glob.glob(str(tmp_path / "*.blackbox.jsonl"))
+    assert files, "survivor produced no blackbox dump"
+    events = []
+    marks = []
+    for f in files:
+        records, _skipped = wiretap.read_blackbox(f)
+        events += [r for r in records if "op" in r]
+        marks += [r for r in records if "mark" in r]
+    assert any(m["mark"] == "peer_down" for m in marks)
+    puts = [e for e in events if e["op"] == "put"]
+    assert any(e["outcome"] == "ok" and e["trace"] == trace_id
+               for e in puts), puts
+    # The victim's death is in the record stream too: the failed RPC
+    # attempts against the dead socket, under the same trace id.
+    assert any(e["outcome"] in ("transport", "deadline_miss")
+               and e["trace"] == trace_id for e in puts), puts
+
+
+def test_sigkilled_snapserve_server_marks_survivor_blackbox(
+    tmp_path, monkeypatch
+):
+    """Same discipline on the read plane: kill a real snapserve server
+    subprocess mid-traffic and the surviving client dumps a blackbox
+    whose tail holds the ok RPCs before the kill and the failure
+    after it."""
+    monkeypatch.setenv("TPUSNAPSHOT_WIRETAP_DIR", str(tmp_path))
+    wiretap.reset()
+    port_file = str(tmp_path / "server.addr")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "torchsnapshot_tpu.snapserve.server",
+         "--addr", "127.0.0.1:0", "--port-file", port_file],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.monotonic() + 30.0
+        addr = None
+        while time.monotonic() < deadline:
+            if os.path.exists(port_file):
+                addr = open(port_file).read().strip()
+                if addr:
+                    break
+            if proc.poll() is not None:
+                pytest.fail("snapserve server subprocess died at startup")
+            time.sleep(0.05)
+        assert addr, "server never wrote its port file"
+        assert snapserve.ping_server(addr, timeout_s=10.0)["ok"] is True
+        proc.kill()
+        proc.wait(timeout=10.0)
+        with pytest.raises(Exception):
+            snapserve.ping_server(addr, timeout_s=2.0)
+        wiretap.note_degrade("server_down", peer=addr)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    files = glob.glob(str(tmp_path / "*.blackbox.jsonl"))
+    assert files
+    events = [
+        r
+        for f in files
+        for r in wiretap.read_blackbox(f)[0]
+        if "op" in r and r.get("transport") == "snapserve"
+    ]
+    assert any(e["op"] == "ping" and e["outcome"] == "ok" for e in events)
+    assert any(e["op"] == "ping" and e["outcome"] != "ok" for e in events)
+
+
+# ----------------------------------- doctor / slo: deadline-margin-collapsing
+
+
+def test_slow_wire_trips_deadline_margin_collapsing(monkeypatch):
+    """Acceptance: an injected ``slow_wire`` fault deterministically
+    trips the doctor rule — the scripted sleep blows the (tightened)
+    per-RPC deadline, the retry lands, and the wiretap window carries
+    the miss into the report's ``wire`` block."""
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_DEADLINE_S", "0.2")
+    monkeypatch.setenv("TPUSNAPSHOT_REPLICATION_RETRY_BUDGET_S", "10")
+    wiretap.reset()
+    from torchsnapshot_tpu.hottier.peer import start_local_peer
+
+    server, _ = start_local_peer(host_id=11, register=False)
+    peer = RemotePeer(host_id=11, addr=server.addr)
+    token = wiretap.window_begin()
+    try:
+        from torchsnapshot_tpu.fingerprint import fingerprint_host
+
+        payload = b"w" * 512
+        tag = fingerprint_host(payload)
+        script_wire_fault("slow_wire", host=11, seconds=0.6)
+        stored, _ = peer.put("k", payload, tag=tag,
+                             root="memory://slowwire/run")
+        assert stored  # the retry after the miss succeeded
+    finally:
+        peer.close()
+        server.stop()
+    window = wiretap.window_collect(token)
+    put = window["snapwire/put"]
+    assert put["deadline_misses"] >= 1
+    assert put["retries"] >= 1
+    report = {"kind": "take", "ranks": [{"rank": 0, "wire": window}]}
+    findings = [
+        f for f in diagnose_report(report)
+        if f.rule == "deadline-margin-collapsing"
+    ]
+    assert findings and findings[0].severity == "critical"
+    assert findings[0].evidence["pressured_ops"][0]["op"] == "snapwire/put"
+    # Healthy traffic stays silent.
+    assert wire_pressure_finding(
+        {"snapwire/put": {"count": 10, "deadline_misses": 0,
+                          "margin_p99": 0.1}}
+    ) is None
+
+
+def test_margin_only_pressure_warns_not_criticals(monkeypatch):
+    monkeypatch.setenv("TPUSNAPSHOT_WIRE_MARGIN_WARN", "0.5")
+    f = wire_pressure_finding(
+        {"snapserve/read": {"count": 50, "deadline_misses": 0,
+                            "margin_p99": 0.62, "p99_s": 6.2,
+                            "deadline_s": 10.0}}
+    )
+    assert f is not None and f.severity == "warn"
+    assert "62%" in f.title
+
+
+def test_slo_live_rule_scores_window_delta():
+    def sample(count, misses):
+        return {
+            "wire": {
+                "ops": {
+                    "snapwire/put": {
+                        "count": count,
+                        "deadline_misses": misses,
+                        "retries": 0,
+                        "margin_p99": 0.2,
+                        "deadline_s": 2.0,
+                    }
+                }
+            }
+        }
+
+    stale = scope_slo.evaluate_live([sample(50, 3), sample(60, 3)])
+    assert not any(
+        f.rule == "deadline-margin-collapsing" for f in stale
+    ), stale
+    fresh = [
+        f
+        for f in scope_slo.evaluate_live([sample(50, 3), sample(60, 5)])
+        if f.rule == "deadline-margin-collapsing"
+    ]
+    assert fresh and fresh[0].severity == "critical"
+    assert fresh[0].evidence["deadline_misses"] == 2
+
+
+# ------------------------------------------------------ ops fleet wire mode
+
+
+def test_ops_fleet_wire_aggregates_and_exit_contract(capsys):
+    server = snapserve.start_local_server()
+    try:
+        snapserve.ping_server(server.addr, timeout_s=10.0)
+        rc = scope_ops.main(["--wire", server.addr])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet wire:" in out
+        assert "snapserve/ping" in out
+        # One member down (but not all): critical finding, exit 1.
+        rc = scope_ops.main(
+            ["--wire", f"{server.addr},127.0.0.1:1", "--wire-timeout", "2"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "fleet-member-unreachable" in out
+    finally:
+        server.stop()
+    # Every target unreachable: the view itself is unavailable, exit 2.
+    rc = scope_ops.main(["--wire", "127.0.0.1:1", "--wire-timeout", "2"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_ops_fleet_wire_json_merges_peer_blocks(capsys):
+    from torchsnapshot_tpu.hottier.peer import start_local_peer
+
+    server, _ = start_local_peer(host_id=21, register=False)
+    peer = RemotePeer(host_id=21, addr=server.addr)
+    try:
+        assert peer.probe() is True
+        rc = scope_ops.main(
+            ["--wire-peers", f"21={server.addr}", "--json"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["reachable"] == 1
+        assert any(k.startswith("snapwire/") for k in doc["ops"])
+    finally:
+        peer.close()
+        server.stop()

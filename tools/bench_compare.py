@@ -238,6 +238,7 @@ def compare(
             f"{verdicts[0] or '—'} -> {verdicts[1] or '—'}"
         )
     lines.extend(_consume_profile_notes(old, new))
+    lines.extend(_wire_ops_notes(old, new))
     return lines, regressions
 
 
@@ -296,6 +297,81 @@ def _consume_profile_notes(
             f"note: dominant consume sub-step changed: "
             f"{dominants[0]} -> {dominants[1]}"
         )
+    return notes
+
+
+# A per-op p99 must move by at least this factor (with a floor on the
+# sample count) before it earns a note — RPC latency on shared CI hosts
+# is weather, not a regression, which is why wire_ops never gates.
+_WIRE_P99_SHIFT_FACTOR = 2.0
+_WIRE_MIN_COUNT = 5
+
+
+def _wire_ops_notes(
+    old: Dict[str, Any], new: Dict[str, Any]
+) -> List[str]:
+    """Note lines (never regressions) on snapflight per-op wire
+    telemetry shifts between two rounds (the ``wire_ops`` windows the
+    bench's wire and fleet sections embed): telemetry keys appearing or
+    disappearing (op-mix shift), a per-op p99 moving by more than
+    ``_WIRE_P99_SHIFT_FACTOR``x, and deadline misses showing up in the
+    NEW run. Wire latency is diagnosis — the gated wire numbers are the
+    delta ratio and overhead above."""
+    notes: List[str] = []
+    for section in ("wire", "fleet"):
+        sides = []
+        for doc in (old, new):
+            ops = (doc.get(section) or {}).get("wire_ops")
+            if not isinstance(ops, dict) or not ops:
+                sides = []
+                break
+            sides.append(ops)
+        if not sides:
+            continue
+        a_ops, b_ops = sides
+        appeared = sorted(set(b_ops) - set(a_ops))
+        vanished = sorted(set(a_ops) - set(b_ops))
+        if appeared or vanished:
+            bits = []
+            if appeared:
+                bits.append("new: " + ", ".join(appeared))
+            if vanished:
+                bits.append("gone: " + ", ".join(vanished))
+            notes.append(
+                f"note: {section} op mix shifted ({'; '.join(bits)})"
+            )
+        shifted = []
+        for key in sorted(set(a_ops) & set(b_ops)):
+            a, b = a_ops[key], b_ops[key]
+            pa = float(a.get("p99_ms") or 0.0)
+            pb = float(b.get("p99_ms") or 0.0)
+            enough = (
+                int(a.get("count") or 0) >= _WIRE_MIN_COUNT
+                and int(b.get("count") or 0) >= _WIRE_MIN_COUNT
+            )
+            if enough and pa > 0 and (
+                pb / pa >= _WIRE_P99_SHIFT_FACTOR
+                or pa / max(pb, 1e-9) >= _WIRE_P99_SHIFT_FACTOR
+            ):
+                shifted.append(f"{key} p99 {pa:g}ms->{pb:g}ms")
+        if shifted:
+            notes.append(
+                f"note: {section} per-op latency shifted: "
+                + ", ".join(shifted)
+            )
+        missed = [
+            f"{key} x{int(b_ops[key].get('deadline_misses') or 0)}"
+            for key in sorted(b_ops)
+            if int(b_ops[key].get("deadline_misses") or 0)
+            > int((a_ops.get(key) or {}).get("deadline_misses") or 0)
+        ]
+        if missed:
+            notes.append(
+                f"note: NEW run's {section} section recorded deadline "
+                f"misses: " + ", ".join(missed)
+                + " (see its blackbox dumps / doctor "
+                "deadline-margin-collapsing)"
+            )
     return notes
 
 
@@ -510,6 +586,64 @@ def _self_test() -> int:
     assert "device_put -> decode" in joined, joined
     lines, _ = compare(xa, dict(xa), 0.2)
     assert not any("sub-phase" in ln for ln in lines), lines
+    # Snapflight wire_ops notes: op-mix changes, big p99 shifts, and
+    # fresh deadline misses are NOTES, never regressions.
+    def _wops(p99_ms, misses=0, count=50):
+        return {
+            "snapwire/put": {
+                "count": count,
+                "p50_ms": p99_ms / 2,
+                "p99_ms": p99_ms,
+                "deadline_misses": misses,
+                "retries": 0,
+            }
+        }
+
+    wa = dict(base, wire={"wire_ops": _wops(4.0)})
+    lines, reg = compare(wa, dict(wa), 0.2)
+    assert not reg and not any("note: wire" in ln for ln in lines), (
+        f"identical wire_ops must stay silent: {lines}"
+    )
+    slow = dict(base, wire={"wire_ops": _wops(12.0)})
+    lines, reg = compare(wa, slow, 0.2)
+    assert not reg, f"wire latency shift must never regress: {reg}"
+    joined = "\n".join(lines)
+    assert "wire per-op latency shifted" in joined, joined
+    assert "snapwire/put p99 4ms->12ms" in joined, joined
+    mixed = dict(
+        base,
+        wire={"wire_ops": dict(_wops(4.0), **{
+            "snapwire/drop": {
+                "count": 9, "p50_ms": 1.0, "p99_ms": 2.0,
+                "deadline_misses": 0, "retries": 0,
+            },
+        })},
+    )
+    lines, reg = compare(wa, mixed, 0.2)
+    assert not reg, f"op-mix shift must never regress: {reg}"
+    assert any(
+        "wire op mix shifted" in ln and "snapwire/drop" in ln
+        for ln in lines
+    ), lines
+    missing = dict(base, fleet={"wire_ops": _wops(4.0, misses=3)})
+    lines, reg = compare(
+        dict(base, fleet={"wire_ops": _wops(4.0)}), missing, 0.2
+    )
+    assert not reg, f"fresh misses must never regress: {reg}"
+    assert any(
+        "deadline misses" in ln and "snapwire/put x3" in ln
+        for ln in lines
+    ), lines
+    lines, reg = compare(base, wa, 0.2)
+    assert not reg and not any("note: wire" in ln for ln in lines), (
+        f"wire_ops absent on one side is skipped: {lines}"
+    )
+    tiny = dict(base, wire={"wire_ops": _wops(4.0, count=2)})
+    tiny_slow = dict(base, wire={"wire_ops": _wops(40.0, count=2)})
+    lines, _ = compare(tiny, tiny_slow, 0.2)
+    assert not any("latency shifted" in ln for ln in lines), (
+        f"under-sampled ops must not earn latency notes: {lines}"
+    )
     print("bench_compare self-test OK")
     return 0
 

@@ -580,6 +580,7 @@ TRANSPORTS: Tuple[Dict[str, Any], ...] = (
         "server_file": "snapserve/server.py",
         "shared_files": ("snapserve/protocol.py",),
         "facade": None,
+        "telemetry_transport": "snapserve",
     },
     {
         "name": "snapwire",
@@ -591,6 +592,7 @@ TRANSPORTS: Tuple[Dict[str, Any], ...] = (
         "server_file": "hottier/peer.py",
         "shared_files": (),
         "facade": None,
+        "telemetry_transport": "snapwire",
     },
     {
         "name": "snapmend",
@@ -602,6 +604,9 @@ TRANSPORTS: Tuple[Dict[str, Any], ...] = (
         "server_file": "hottier/peer.py",
         "shared_files": ("hottier/transport.py",),
         "facade": FACADE_METHOD_OPS,
+        # A facade has no frames of its own: its RPCs surface in the
+        # wiretap under the transport whose wire it rides.
+        "telemetry_transport": "snapwire",
     },
 )
 
@@ -678,6 +683,12 @@ def build_inventory(root: Optional[str] = None) -> Dict[str, Any]:
             entry["handler"] = h["handler"] if h else None
             entry["handled"] = bool(h and h["defined"])
             entry["retry"] = h["retry"] if h else "unspecified"
+            # snapflight join key: every wiretap sample for this op
+            # carries this "{transport}/{op}" label pair; the
+            # conformance test pins sample keys == inventory keys.
+            entry["telemetry_key"] = (
+                f"{spec['telemetry_transport']}/{op}"
+            )
             if "via_methods" in entry:
                 entry["via_methods"] = sorted(set(entry["via_methods"]))
         idempotent: Optional[List[str]] = None
@@ -715,6 +726,7 @@ def build_inventory(root: Optional[str] = None) -> Dict[str, Any]:
                 "description": spec["description"],
                 "client_files": list(spec["client_files"]),
                 "server_file": spec["server_file"],
+                "telemetry_transport": spec["telemetry_transport"],
                 "ops": ops,
                 "ops_without_handler": sorted(
                     op
@@ -819,8 +831,11 @@ def render_markdown(inventory: Dict[str, Any]) -> str:
             + ", ".join(f"`{c}`" for c in t["client_files"])
         )
         out.append("")
-        out.append("| op | handler | retry | idempotent | request fields |")
-        out.append("|---|---|---|---|---|")
+        out.append(
+            "| op | handler | retry | idempotent | telemetry key "
+            "| request fields |"
+        )
+        out.append("|---|---|---|---|---|---|")
         idem = set(t["idempotent_ops"] or [])
         for op in sorted(t["ops"]):
             e = t["ops"][op]
@@ -833,9 +848,10 @@ def render_markdown(inventory: Dict[str, Any]) -> str:
             fields = ", ".join(
                 t["request_fields_by_op"].get(op, [])
             ) or "—"
+            tkey = e.get("telemetry_key") or "—"
             out.append(
                 f"| `{op}`{via} | `{handler}` | {e.get('retry')} | "
-                f"{'yes' if op in idem else 'no'} | {fields} |"
+                f"{'yes' if op in idem else 'no'} | `{tkey}` | {fields} |"
             )
         if t["ops_without_handler"]:
             out.append("")

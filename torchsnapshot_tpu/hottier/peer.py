@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import wire
+from .. import tracing, wire, wiretap
 from ..utils.env import env_int
 from . import tier
 
@@ -207,12 +207,47 @@ class PeerServer:
     ) -> Tuple[Dict[str, Any], bytes]:
         op = header.get("op")
         base: Dict[str, Any] = {"v": wire.PROTOCOL_VERSION}
-        # The server half of the wire addresses its LOCAL store even
-        # when this same process registered the host id as remote (the
-        # in-process test form) — without the scope, tier calls would
-        # route back through the RemotePeer into this very server.
-        with tier.serve_local():
-            return self._dispatch(op, base, header, payload)
+        start = time.monotonic()
+        # Adopt the client's trace id off the frame so this server-side
+        # wiretap event joins the same merged snapxray trace.
+        trace_id = header.get("trace")
+        with tracing.adopt_trace(
+            trace_id if isinstance(trace_id, str) else None
+        ):
+            # The server half of the wire addresses its LOCAL store even
+            # when this same process registered the host id as remote
+            # (the in-process test form) — without the scope, tier calls
+            # would route back through the RemotePeer into this very
+            # server.
+            with tier.serve_local():
+                response, resp_payload = self._dispatch(
+                    op, base, header, payload
+                )
+            try:
+                # Unknown ops stay out of the wiretap: the telemetry
+                # key space is exactly the PROTOCOL.md op inventory
+                # (the conformance test holds us to it); a bad_request
+                # probe must not mint a new label.
+                if op in HOT_TIER_OPS:
+                    wiretap.record(
+                        "snapwire",
+                        op,
+                        seconds=time.monotonic() - start,
+                        outcome=(
+                            "ok"
+                            if response.get("ok")
+                            else wiretap.outcome_from_wire_error(
+                                response.get("error")
+                            )
+                        ),
+                        bytes_in=len(payload),
+                        bytes_out=len(resp_payload),
+                    )
+            except Exception:  # pragma: no cover - defensive
+                logger.debug(
+                    "hottier.peer: wiretap record failed", exc_info=True
+                )
+        return response, resp_payload
 
     def _dispatch(
         self,
@@ -438,7 +473,18 @@ class PeerServer:
             "objects": 0,
             "undrained_bytes": 0,
         }
-        return {**base, "ok": True, "occupancy": occ}, b""
+        resp = {**base, "ok": True, "occupancy": occ}
+        # This peer's own wire view rides the stats op so the ops CLI's
+        # fleet-wide wire section can aggregate peers without a new op.
+        try:
+            block = wiretap.sample_block()
+            if block.get("ops"):
+                resp["wire"] = block
+        except Exception:  # pragma: no cover - defensive
+            logger.debug(
+                "hottier.peer: wiretap sample failed", exc_info=True
+            )
+        return resp, b""
 
     def _do_ping(
         self, header: Dict[str, Any], payload: bytes = b""

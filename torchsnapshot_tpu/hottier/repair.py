@@ -471,6 +471,16 @@ class RepairPlane:
             f"snapmend: host {host_id} (gen {view.generation}) classified "
             f"LOST ({reason}); condemning and invalidating its shadow"
         )
+        # A declared host loss is postmortem time: flush this process's
+        # flight recorder so the victim's last RPCs survive on disk.
+        try:
+            from .. import wiretap
+
+            wiretap.note_degrade(
+                "host_lost", peer=getattr(peer, "addr_str", None)
+            )
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapmend: blackbox dump failed", exc_info=True)
         # Latch the JUDGED peer object directly, and clear the host's
         # shadow only while that object is still the registered one
         # (only_if): a replacement registered mid-tick must never be

@@ -71,7 +71,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import telemetry, wire
+from .. import telemetry, tracing, wire, wiretap
 from ..fingerprint import fingerprint_host, fingerprint_host_chunked
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float, env_int
@@ -363,6 +363,12 @@ class RemotePeer:
         )
         with self._lock:
             self._down_until = time.monotonic() + cooldown
+        # A latched-down peer is a degrade event: flush the flight
+        # recorder so the last RPCs against it survive a later crash.
+        try:
+            wiretap.note_degrade("peer_down", peer=self.addr_str)
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapwire: blackbox dump failed", exc_info=True)
 
     def _is_down(self) -> bool:
         with self._lock:
@@ -566,8 +572,39 @@ class RemotePeer:
                 self._abort_conn_on_loop()
                 raise
 
+    def _tap(
+        self,
+        op: str,
+        start: float,
+        outcome: str,
+        sent: int,
+        received: int,
+        attempt: int,
+        deadline_s: float,
+    ) -> None:
+        """Best-effort wiretap record for one attempt — observability
+        must never take the transport down with it."""
+        try:
+            wiretap.record(
+                "snapwire",
+                op,
+                seconds=time.monotonic() - start,
+                outcome=outcome,
+                bytes_out=sent,
+                bytes_in=received,
+                attempt=attempt,
+                deadline_s=deadline_s,
+                peer=self.addr_str,
+            )
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapwire: wiretap record failed", exc_info=True)
+
     def _call_once(
-        self, header: Dict[str, Any], payload: bytes, deadline_s: float
+        self,
+        header: Dict[str, Any],
+        payload: bytes,
+        deadline_s: float,
+        attempt: int = 0,
     ) -> Tuple[Dict[str, Any], bytes]:
         op = header.get("op")
         if op not in HOT_TIER_OPS:
@@ -578,6 +615,12 @@ class RemotePeer:
             raise HostLostError(
                 f"peer host {self.host_id} ({self.addr_str}) is dead"
             )
+        # Stamp the ambient snapxray trace onto the frame so the peer's
+        # server-side wiretap events join the same merged trace.
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            header["trace"] = trace_id
+        start = time.monotonic()
         fut = asyncio.run_coroutine_threadsafe(
             self._rpc(header, payload, deadline_s), _loop()
         )
@@ -586,21 +629,52 @@ class RemotePeer:
         # behind other RPCs on this peer is bounded by THEIR deadlines.
         backstop_s = deadline_s * 8 + 60.0
         try:
-            return fut.result(timeout=backstop_s)
+            resp, resp_payload = fut.result(timeout=backstop_s)
         except _DeadlineMiss as e:
+            self._tap(
+                op, start, "deadline_miss", len(payload), 0, attempt,
+                deadline_s,
+            )
             _bump("deadline_misses")
             telemetry.counter(
                 _metric_names.HOT_TIER_REPLICATION_DEADLINE_MISSES
             ).inc()
             raise _WireFailure(str(e)) from None
         except concurrent.futures.TimeoutError:
+            self._tap(
+                op, start, "transport", len(payload), 0, attempt, deadline_s
+            )
             fut.cancel()
             self.abort_connections()
             raise _WireFailure(
                 f"RPC backstop ({backstop_s:g}s) exceeded"
             ) from None
         except _WIRE_ERRORS as e:
+            self._tap(
+                op,
+                start,
+                wiretap.classify_error(e),
+                len(payload),
+                0,
+                attempt,
+                deadline_s,
+            )
             raise _WireFailure(repr(e)) from e
+        outcome = (
+            "ok"
+            if resp.get("ok")
+            else wiretap.outcome_from_wire_error(resp.get("error"))
+        )
+        self._tap(
+            op,
+            start,
+            outcome,
+            len(payload),
+            len(resp_payload),
+            attempt,
+            deadline_s,
+        )
+        return resp, resp_payload
 
     def _call(
         self,
@@ -646,7 +720,9 @@ class RemotePeer:
         while True:
             attempt += 1
             try:
-                return self._call_once(header, payload, deadline)
+                return self._call_once(
+                    header, payload, deadline, attempt=attempt - 1
+                )
             except _WireFailure as e:
                 delay = min(
                     cap,
@@ -665,6 +741,13 @@ class RemotePeer:
                 telemetry.counter(
                     _metric_names.HOT_TIER_REPLICATION_RETRIES
                 ).inc()
+                tracing.instant(
+                    "snapwire.retry",
+                    op=header.get("op"),
+                    peer=self.addr_str,
+                    attempt=attempt,
+                    delay_s=round(delay, 3),
+                )
                 logger.warning(
                     f"snapwire: RPC to peer host {self.host_id} failed "
                     f"(attempt {attempt}): {e}; retrying in {delay:.2f}s"
@@ -929,6 +1012,22 @@ class RemotePeer:
         except HostLostError:
             return None
         return resp.get("occupancy") if resp.get("ok") else None
+
+    def wire_stats(self) -> Optional[Dict[str, Any]]:
+        """The peer's wiretap ``wire`` sample block (piggybacked on the
+        ``stats`` op) — the ops CLI's fleet-wide wire view reads this.
+        None when the peer is down or has recorded no RPCs yet. A
+        probe, so best-effort: one attempt, no retry budget (the
+        caller's verdict for an unreachable peer IS the answer)."""
+        try:
+            resp, _ = self._call(
+                {"v": wire.PROTOCOL_VERSION, "op": "stats"},
+                best_effort=True,
+            )
+        except HostLostError:
+            return None
+        block = resp.get("wire") if resp.get("ok") else None
+        return block if isinstance(block, dict) else None
 
 
 # --------------------------------------------------------- registration

@@ -220,6 +220,7 @@ async def retry_storage_op(make_coro, desc: str):
     start = time.monotonic()
     prev_delay = floor
     for attempt in range(1, attempts + 1):
+        attempt_start = time.monotonic()
         try:
             return await make_coro()
         except asyncio.CancelledError:
@@ -261,6 +262,7 @@ async def retry_storage_op(make_coro, desc: str):
                 "storage_retry",
                 op=desc,
                 attempt=attempt,
+                attempt_s=round(time.monotonic() - attempt_start, 4),
                 delay_s=round(delay, 4),
                 error=type(e).__name__,
             )

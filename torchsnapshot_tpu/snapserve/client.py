@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import telemetry, tracing
+from .. import telemetry, tracing, wiretap
 from ..io_types import IOReq, StoragePlugin, emit_storage_op, io_payload
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float
@@ -96,6 +96,33 @@ class _TransportFailure(Exception):
     """The server could not be spoken to (dial/send/recv/framing died).
     Internal: always caught by ``read()`` and converted to a fallback;
     ``__cause__`` carries the underlying failure."""
+
+
+def _tap(
+    op: str,
+    start: float,
+    outcome: str,
+    timeout_s: float,
+    *,
+    bytes_in: int = 0,
+    bytes_out: int = 0,
+    peer: Optional[str] = None,
+) -> None:
+    """Best-effort wiretap record for one snapserve RPC attempt —
+    observability must never take the client down with it."""
+    try:
+        wiretap.record(
+            "snapserve",
+            op,
+            seconds=time.monotonic() - start,
+            outcome=outcome,
+            bytes_in=bytes_in,
+            bytes_out=bytes_out,
+            deadline_s=timeout_s,
+            peer=peer,
+        )
+    except Exception:  # pragma: no cover - defensive
+        logger.debug("snapserve: wiretap record failed", exc_info=True)
 
 
 def parse_snapserve_url(spec: str) -> Tuple[str, str]:
@@ -297,7 +324,14 @@ def ping_server(addr: str, timeout_s: float = 10.0) -> Dict[str, Any]:
         finally:
             writer.close()
 
-    return asyncio.run(_ping())
+    start = time.monotonic()
+    try:
+        result = asyncio.run(_ping())
+    except BaseException as e:
+        _tap("ping", start, wiretap.classify_error(e), timeout_s, peer=addr)
+        raise
+    _tap("ping", start, "ok", timeout_s, peer=addr)
+    return result
 
 
 def fetch_member_info(addr: str, timeout_s: float = 10.0) -> Dict[str, Any]:
@@ -330,7 +364,17 @@ def fetch_member_info(addr: str, timeout_s: float = 10.0) -> Dict[str, Any]:
         finally:
             writer.close()
 
-    return asyncio.run(_fetch())
+    start = time.monotonic()
+    try:
+        result = asyncio.run(_fetch())
+    except BaseException as e:
+        _tap(
+            "membership", start, wiretap.classify_error(e), timeout_s,
+            peer=addr,
+        )
+        raise
+    _tap("membership", start, "ok", timeout_s, peer=addr)
+    return result
 
 
 def plan_remote(
@@ -365,7 +409,14 @@ def plan_remote(
         finally:
             writer.close()
 
-    return asyncio.run(_plan())
+    start = time.monotonic()
+    try:
+        result = asyncio.run(_plan())
+    except BaseException as e:
+        _tap("plan", start, wiretap.classify_error(e), timeout_s, peer=addr)
+        raise
+    _tap("plan", start, "ok", timeout_s, peer=addr)
+    return result
 
 
 class SnapServePlugin(StoragePlugin):
@@ -520,6 +571,10 @@ class SnapServePlugin(StoragePlugin):
         )
         with self._lock:
             self._down_until = time.monotonic() + cooldown
+        try:
+            wiretap.note_degrade("server_down", peer=self._addr_str)
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapserve: blackbox dump failed", exc_info=True)
 
     def _is_down(self) -> bool:
         with self._lock:
@@ -531,6 +586,7 @@ class SnapServePlugin(StoragePlugin):
         self, addr: str, path: str, byte_range: Optional[tuple]
     ) -> bytes:
         timeout_s = env_float(TIMEOUT_ENV_VAR, _DEFAULT_TIMEOUT_S)
+        start = time.monotonic()
         # Causal context on the wire (snapxray): the restore root's
         # trace id + a flow id the server's spans bind to — the merged
         # trace draws the client→server arrow from this pair. Generated
@@ -543,6 +599,7 @@ class SnapServePlugin(StoragePlugin):
         try:
             conn = await self._checkout(addr)
         except _TRANSPORT_ERRORS as e:
+            _tap("read", start, "transport", timeout_s, peer=addr)
             raise _TransportFailure(f"dial {addr}: {e!r}") from e
         reader, writer = conn
         header_doc: Dict[str, Any] = {
@@ -577,6 +634,18 @@ class SnapServePlugin(StoragePlugin):
                 logger.debug(
                     "snapserve conn abort failed", exc_info=True
                 )
+            # A wait_for expiry IS a blown per-RPC budget, distinct
+            # from a dead peer — the deadline-margin story needs the
+            # two separated.
+            _tap(
+                "read",
+                start,
+                "deadline_miss"
+                if isinstance(e, asyncio.TimeoutError)
+                else wiretap.classify_error(e),
+                timeout_s,
+                peer=addr,
+            )
             if isinstance(e, _TRANSPORT_ERRORS):
                 raise _TransportFailure(
                     f"rpc to {addr}: {e!r}"
@@ -590,7 +659,17 @@ class SnapServePlugin(StoragePlugin):
             # The SERVER answered: this is the backend's verdict
             # (not-found / range / backend failure), not unreachability
             # — it propagates exactly as a direct read would raise it.
+            _tap(
+                "read",
+                start,
+                wiretap.outcome_from_wire_error(header.get("error")),
+                timeout_s,
+                peer=addr,
+            )
             raise wire_to_error(header.get("error"), path)
+        _tap(
+            "read", start, "ok", timeout_s, bytes_in=len(payload), peer=addr
+        )
         return payload
 
     # ---------------------------------------------------------------- reads
@@ -661,6 +740,12 @@ class SnapServePlugin(StoragePlugin):
                     addr=addr,
                     cooldown_s=cooldown,
                 )
+                try:
+                    wiretap.note_degrade("fleet_member_down", peer=addr)
+                except Exception:  # pragma: no cover - defensive
+                    logger.debug(
+                        "snapserve: blackbox dump failed", exc_info=True
+                    )
                 continue
             if attempted > 0:
                 outcome = "failover"

@@ -307,6 +307,19 @@ class FleetSupervisor:
                         f"snapfleet: member {record.name!r} "
                         f"({record.addr}) is down: {e!r}"
                     )
+                    # Down TRANSITION: flush the flight recorder so the
+                    # dead member's last probes survive on disk.
+                    try:
+                        from .. import wiretap
+
+                        wiretap.note_degrade(
+                            "fleet_member_down", peer=record.addr
+                        )
+                    except Exception:  # pragma: no cover - defensive
+                        logger.debug(
+                            "snapfleet: blackbox dump failed",
+                            exc_info=True,
+                        )
                 self.membership.mark(record.name, "down")
                 continue
             generation = int(info.get("generation") or 0)

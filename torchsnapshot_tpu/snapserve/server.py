@@ -58,7 +58,7 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
-from .. import telemetry, tracing
+from .. import telemetry, tracing, wiretap
 from ..io_types import IOReq, StoragePlugin, io_payload
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float, env_int
@@ -1030,6 +1030,7 @@ class SnapServer:
         # row, and snapcheck's SNAP010 fails the build if either half
         # drifts.
         meta = READ_PLANE_OPS.get(op) if isinstance(op, str) else None
+        start = time.monotonic()
         try:
             if meta is None:
                 response.update(
@@ -1053,6 +1054,40 @@ class SnapServer:
             # test); the client sees a backend error. Real crashes of
             # the server itself are modeled by kill_server.
             response.update(ok=False, error=error_to_wire(e))
+        if meta is not None:
+            # Server half of the wiretap: handler time (admission and
+            # flow-control stalls are the CLIENT's wait, accounted in
+            # its own samples), joined to the client's snapxray trace
+            # by the id it stamped on the frame. Unknown ops stay out —
+            # the telemetry key space is exactly the PROTOCOL.md op
+            # inventory.
+            wire_trace = header.get("trace")
+            if not isinstance(wire_trace, dict):
+                wire_trace = {}
+            req_trace = wire_trace.get("id")
+            try:
+                wiretap.record(
+                    "snapserve",
+                    op,
+                    seconds=time.monotonic() - start,
+                    outcome=(
+                        "ok"
+                        if response.get("ok")
+                        else wiretap.outcome_from_wire_error(
+                            response.get("error")
+                        )
+                    ),
+                    bytes_in=len(req_payload),
+                    bytes_out=len(payload),
+                    peer=client,
+                    trace_id=(
+                        req_trace if isinstance(req_trace, str) else None
+                    ),
+                )
+            except Exception:  # pragma: no cover - defensive
+                logger.debug(
+                    "snapserve: wiretap record failed", exc_info=True
+                )
         # Admission order: tenant quota (fleet-wide fairness) outside,
         # per-connection flow control inside — a tenant over ITS quota
         # parks here without holding connection-gate capacity.
@@ -1117,6 +1152,15 @@ class SnapServer:
         ).inc()
         stats = self.service.stats()
         stats["tenants"] = self._tenants.stats()
+        # This member's own wire view rides the stats op so the ops
+        # CLI's fleet-wide wire section can aggregate members without a
+        # new op.
+        try:
+            block = wiretap.sample_block()
+            if block.get("ops"):
+                stats["wire"] = block
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapserve: wiretap sample failed", exc_info=True)
         return {"stats": stats}, b""
 
     async def _op_ping(

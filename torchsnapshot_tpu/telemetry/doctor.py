@@ -61,6 +61,14 @@ budget                    the report by the hot tier's drain) exceeded
                           (telemetry/slo.py) fires the same rule id
                           LIVE from sampler state, before the
                           watermark exists to prove it post-hoc.
+deadline-margin-          an op's wiretap window shows p99 latency
+collapsing                consuming >= TPUSNAPSHOT_WIRE_MARGIN_WARN
+                          (default 0.70) of its per-RPC deadline —
+                          the hand-tuned deadline knob is nearly
+                          collapsed onto real latency (warn); critical
+                          when the window recorded outright deadline
+                          misses. The SLO engine fires the same rule
+                          id LIVE from sampler wire blocks
 dedup-ineffective         a chunked take's chunk-level dedup saved no
                           more bytes than leaf-level dedup would have
                           (every hit byte sat inside a fully-clean
@@ -109,6 +117,11 @@ _STRIPE_RATIO = 2.0
 _CKPT_BUDGET_ENV_VAR = "TPUSNAPSHOT_CKPT_BUDGET_PCT"
 _DEFAULT_CKPT_BUDGET_PCT = 5.0
 _MIN_GOODPUT_WINDOW_S = 10.0
+# Deadline-margin pressure threshold (wiretap): an op whose p99 latency
+# consumes this fraction of its per-RPC deadline is one latency wobble
+# from missing it — warn before the misses start.
+_WIRE_MARGIN_WARN_ENV_VAR = "TPUSNAPSHOT_WIRE_MARGIN_WARN"
+_DEFAULT_WIRE_MARGIN_WARN = 0.70
 # Phases must clear this floor before a ratio means anything: a 0.05s
 # consume "dominating" a 0.006s read is scheduler jitter on a tiny
 # operation, not a pathology worth a remediation hint — the findings
@@ -947,6 +960,112 @@ def _rule_dedup_ineffective(report: Dict[str, Any]) -> Optional[Finding]:
     )
 
 
+def wire_margin_warn_threshold() -> float:
+    return env_float(_WIRE_MARGIN_WARN_ENV_VAR, _DEFAULT_WIRE_MARGIN_WARN)
+
+
+def wire_pressure_finding(
+    ops: Dict[str, Any], source: str = "report"
+) -> Optional[Finding]:
+    """The shared deadline-margin verdict over wiretap per-op blocks —
+    flight-report ``wire`` blocks post-hoc (this module), sampler
+    ``wire`` blocks live (telemetry/slo.py): same rule id both ways.
+
+    Critical when the window recorded outright deadline misses; warn
+    when an op's p99 consumed >= TPUSNAPSHOT_WIRE_MARGIN_WARN of its
+    per-RPC deadline — the hand-tuned knob is one latency wobble from
+    collapsing onto real latency."""
+    if not ops:
+        return None
+    warn_at = wire_margin_warn_threshold()
+    misses = 0
+    pressured: List[Any] = []
+    for op_key, entry in ops.items():
+        if not isinstance(entry, dict):
+            continue
+        op_misses = int(entry.get("deadline_misses") or 0)
+        misses += op_misses
+        margin = entry.get("margin_p99")
+        if op_misses > 0 or (
+            margin is not None and float(margin) >= warn_at
+        ):
+            pressured.append(
+                (op_misses, float(margin or 0.0), op_key, entry)
+            )
+    if not pressured:
+        return None
+    pressured.sort(reverse=True)
+    evidence = {
+        "source": source,
+        "deadline_misses": misses,
+        "margin_warn_at": warn_at,
+        "pressured_ops": [
+            {
+                "op": op_key,
+                "margin_p99": round(margin, 4) if margin else None,
+                "p99_s": entry.get("p99_s"),
+                "deadline_s": entry.get("deadline_s"),
+                "deadline_misses": op_misses,
+            }
+            for op_misses, margin, op_key, entry in pressured[:5]
+        ],
+    }
+    worst = pressured[0]
+    if misses > 0:
+        title = (
+            f"{misses} wire RPC(s) missed their deadline "
+            f"(worst op: {worst[2]})"
+        )
+        severity = "critical"
+    else:
+        title = (
+            f"wire op {worst[2]} p99 is consuming "
+            f"{worst[1]:.0%} of its RPC deadline "
+            f"(warn threshold {warn_at:.0%})"
+        )
+        severity = "warn"
+    return Finding(
+        rule="deadline-margin-collapsing",
+        severity=severity,
+        title=title,
+        evidence=evidence,
+        remediation=(
+            "the per-RPC deadline budget is collapsing onto real "
+            "latency for the ops listed. Either the knob is mis-sized "
+            "— raise TPUSNAPSHOT_REPLICATION_DEADLINE_S (snapwire "
+            "ops) / TPUSNAPSHOT_SNAPSERVE_TIMEOUT_S (snapserve ops) — "
+            "or the wire got slower: check peer placement and payload "
+            "sizes (delta replication + codec settings shrink push "
+            "frames). Misses already take the safe degradation paths "
+            "(write-through before the ack, direct-backend fallback "
+            "reads), so correctness held; latency is paying for it."
+        ),
+    )
+
+
+def _rule_deadline_margin_collapsing(
+    report: Dict[str, Any]
+) -> Optional[Finding]:
+    # Merge per-rank wire blocks per op: counts sum, quantiles take the
+    # worst rank (a p99 cannot be averaged across ranks).
+    ops: Dict[str, Dict[str, Any]] = {}
+    for s in _ranks(report):
+        for op_key, entry in (s.get("wire") or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            acc = ops.get(op_key)
+            if acc is None:
+                ops[op_key] = dict(entry)
+                continue
+            for k in ("count", "deadline_misses", "retries"):
+                acc[k] = int(acc.get(k) or 0) + int(entry.get(k) or 0)
+            for k in ("p99_s", "margin_p99", "margin_max"):
+                v = entry.get(k)
+                if v is not None:
+                    acc[k] = max(float(acc.get(k) or 0.0), float(v))
+    return wire_pressure_finding(ops, source="report")
+
+
 RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_consume_dominated,
     _rule_read_dominated,
@@ -963,6 +1082,7 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_read_plane_degraded,
     _rule_fleet_degraded,
     _rule_dedup_ineffective,
+    _rule_deadline_margin_collapsing,
 ]
 
 _SEVERITY_ORDER = {"critical": 0, "warn": 1}

@@ -105,6 +105,13 @@ source operation did not produce them::
                                          # consume_s; h2d_fraction =
                                          # consume GB/s over the
                                          # measured H2D probe
+      "wire": {"rpcs", "deadline_misses", "retries",
+               "worst_margin_p99"?, "worst_margin_op"?,
+               "slowest_p99_s"?, "slowest_op"?} | null,
+                                         # wiretap (snapflight) headline:
+                                         # total RPCs this operation put
+                                         # on any transport + the worst
+                                         # deadline-pressure op
       "durability_lag_s": null,          # ALWAYS null on take records —
                                          # the digest is written at commit,
                                          # while the ack→.tierdown window
@@ -707,6 +714,55 @@ def _consume_totals(
     return out
 
 
+def _wire_totals(
+    summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank ``wire`` blocks (wiretap windows) into the
+    digest's ``wire`` field: RPC/miss/retry totals plus the single
+    worst deadline-pressure op and the slowest op across all ranks —
+    the headline the timeline trends without carrying every op row.
+    None when no rank put traffic on any transport."""
+    noted = [s.get("wire") for s in summaries if s and s.get("wire")]
+    if not noted:
+        return None
+    rpcs = 0
+    misses = 0
+    retries = 0
+    worst_margin: Optional[float] = None
+    worst_margin_op: Optional[str] = None
+    slowest_p99: Optional[float] = None
+    slowest_op: Optional[str] = None
+    for block in noted:
+        for op_key, entry in block.items():
+            if not isinstance(entry, dict):
+                continue
+            rpcs += int(entry.get("count") or 0)
+            misses += int(entry.get("deadline_misses") or 0)
+            retries += int(entry.get("retries") or 0)
+            m = entry.get("margin_p99")
+            if m is not None and (worst_margin is None or m > worst_margin):
+                worst_margin = float(m)
+                worst_margin_op = op_key
+            p99 = entry.get("p99_s")
+            if p99 is not None and (
+                slowest_p99 is None or p99 > slowest_p99
+            ):
+                slowest_p99 = float(p99)
+                slowest_op = op_key
+    out: Dict[str, Any] = {
+        "rpcs": rpcs,
+        "deadline_misses": misses,
+        "retries": retries,
+    }
+    if worst_margin is not None:
+        out["worst_margin_p99"] = round(worst_margin, 4)
+        out["worst_margin_op"] = worst_margin_op
+    if slowest_p99 is not None:
+        out["slowest_p99_s"] = slowest_p99
+        out["slowest_op"] = slowest_op
+    return out
+
+
 def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
     """Fold a merged flight report (take or restore) into one ledger
     record. Runs the doctor over the report so the record carries the
@@ -754,6 +810,7 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "tier": _tier_totals(summaries),
         "read_plane": _read_plane_totals(summaries),
         "consume": _consume_totals(summaries),
+        "wire": _wire_totals(summaries),
         # Null by construction at commit time (see the schema note);
         # the hot tier's drain appends a `tierdown` event record that
         # carries the closed window.

@@ -528,3 +528,32 @@ H2D_OVERLAP_SECONDS = (
     "tpusnapshot_h2d_overlap_seconds_total"  # counter
 )
 H2D_OVERLAP_BYTES = "tpusnapshot_h2d_overlap_bytes_total"  # counter
+
+# Wire observability (wiretap.py, "snapflight"): the shared per-op RPC
+# telemetry layer every transport routes through — snapserve server +
+# client (incl. the fleet ladder), the snapwire hot-tier transport/peer
+# pair, and the repair/membership probes. `transport` is the PROTOCOL.md
+# transport owning the frames ("snapserve" | "snapwire"); `op` is the
+# wire op; both label sets are bounded by the op registries. Margin is
+# the fraction of the per-RPC deadline the call consumed (1.0 == the
+# whole budget); misses count RPCs that blew their deadline outright.
+# Blackbox dumps count flight-recorder flushes by trigger reason.
+WIRE_OP_SECONDS = "tpusnapshot_wire_op_seconds"  # hist {transport,op}
+WIRE_OP_BYTES = (
+    "tpusnapshot_wire_op_bytes_total"  # counter {transport,op,dir}
+)
+WIRE_OP_RESULTS = (
+    "tpusnapshot_wire_op_results_total"  # counter {transport,op,result}
+)
+WIRE_DEADLINE_MARGIN = (
+    "tpusnapshot_wire_deadline_margin"  # hist {transport,op}
+)
+WIRE_DEADLINE_MISSES = (
+    "tpusnapshot_wire_deadline_misses_total"  # counter {transport,op}
+)
+WIRE_RETRIES = (
+    "tpusnapshot_wire_retry_attempts_total"  # counter {transport,op}
+)
+WIRE_BLACKBOX_DUMPS = (
+    "tpusnapshot_wire_blackbox_dumps_total"  # counter {reason}
+)

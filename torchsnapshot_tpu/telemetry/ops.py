@@ -35,6 +35,15 @@ be in flight — a draining backlog is normal operation); 1 = a CRITICAL
 finding is active (stranded drains — the output names the roots —
 durability-lag breach, an SLO burning across both windows, a doctor
 critical); 2 = usage/storage error.
+
+**Fleet wire mode** (snapflight): ``--wire addr,addr`` polls snapserve
+servers and ``--wire-peers addr,addr`` polls snapwire hot-tier peers
+for their wiretap sample blocks (piggybacked on the ``stats`` RPC),
+merges the per-op latency/deadline-margin summaries fleet-wide, and
+renders a ``fleet wire`` section: per-member RPC totals and the
+slowest ops by p99. Exit contract: deadline misses anywhere in the
+fleet (or an unreachable member) → 1; EVERY target unreachable → 2
+(the view itself is unavailable).
 """
 
 import argparse
@@ -142,6 +151,173 @@ def findings_of(state: Dict[str, Any]) -> List[Finding]:
     )
     state["slo"] = result
     return list(result["findings"]) + list(state["report_findings"])
+
+
+# ----------------------------------------------------------- fleet wire
+
+
+def collect_fleet_wire(
+    server_addrs: List[str],
+    peer_addrs: List[str],
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """snapflight's fleet-wide wire view: poll every target's ``stats``
+    RPC (snapserve servers via :func:`fetch_server_stats`, snapwire
+    peers via :meth:`RemotePeer.wire_stats` — both piggyback the
+    wiretap sample block) and merge the per-op summaries across the
+    fleet. Per telemetry key: counts/misses/retries SUM across
+    processes, latency/margin percentiles take the fleet-wide MAX (the
+    question is "is any member's wire collapsing", not the average).
+    Unreachable targets are recorded, not raised — the caller decides
+    the exit-code verdict."""
+    targets: List[Dict[str, Any]] = []
+    for addr in server_addrs:
+        entry: Dict[str, Any] = {"target": addr, "transport": "snapserve"}
+        try:
+            from ..snapserve.server import fetch_server_stats
+
+            stats = fetch_server_stats(addr, timeout_s=timeout_s)
+            entry["ok"] = True
+            wire = stats.get("wire")
+            if isinstance(wire, dict):
+                entry["wire"] = wire
+        except Exception as e:
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"
+        targets.append(entry)
+    for i, addr in enumerate(peer_addrs):
+        entry = {"target": addr, "transport": "snapwire"}
+        try:
+            from ..hottier.transport import RemotePeer
+
+            peer = RemotePeer(-(i + 1), addr)
+            wire = peer.wire_stats()
+            if wire is None:
+                raise ConnectionError("peer unreachable or down")
+            entry["ok"] = True
+            if wire.get("ops"):
+                entry["wire"] = wire
+        except Exception as e:
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"
+        targets.append(entry)
+    ops: Dict[str, Dict[str, Any]] = {}
+    for entry in targets:
+        for key, block in ((entry.get("wire") or {}).get("ops") or {}).items():
+            if not isinstance(block, dict):
+                continue
+            agg = ops.setdefault(key, {})
+            for field in (
+                "count",
+                "deadline_misses",
+                "retries",
+                "bytes_in",
+                "bytes_out",
+            ):
+                agg[field] = int(agg.get(field) or 0) + int(
+                    block.get(field) or 0
+                )
+            for field in ("p50_s", "p99_s", "margin_p99", "margin_max"):
+                v = block.get(field)
+                if v is not None:
+                    agg[field] = max(float(agg.get(field) or 0.0), float(v))
+            if block.get("deadline_s") is not None:
+                agg["deadline_s"] = block["deadline_s"]
+    reachable = sum(1 for t in targets if t.get("ok"))
+    return {
+        "targets": targets,
+        "ops": ops,
+        "reachable": reachable,
+        "unreachable": len(targets) - reachable,
+    }
+
+
+def fleet_wire_findings(fleet: Dict[str, Any]) -> List[Finding]:
+    """The fleet wire verdict: unreachable members are critical (the
+    probe WAS the liveness check), and the merged per-op blocks go
+    through the same deadline-pressure rule the doctor and slo use."""
+    findings: List[Finding] = []
+    down = [t for t in fleet["targets"] if not t.get("ok")]
+    if down:
+        findings.append(
+            Finding(
+                rule="fleet-member-unreachable",
+                severity="critical",
+                title=(
+                    f"{len(down)} of {len(fleet['targets'])} fleet "
+                    f"target(s) unreachable"
+                ),
+                evidence={
+                    "unreachable": [
+                        {
+                            "target": t["target"],
+                            "transport": t["transport"],
+                            "error": t.get("error"),
+                        }
+                        for t in down
+                    ]
+                },
+                remediation=(
+                    "the stats probe could not reach these members — "
+                    "check process liveness (fleet supervisor / repair "
+                    "membership view) and their blackbox dumps "
+                    "(*.blackbox.jsonl under TPUSNAPSHOT_WIRETAP_DIR) "
+                    "for their last recorded RPCs."
+                ),
+            )
+        )
+    from .doctor import wire_pressure_finding
+
+    pressure = wire_pressure_finding(fleet["ops"], source="fleet")
+    if pressure is not None:
+        findings.append(pressure)
+    return findings
+
+
+def _render_fleet_wire(fleet: Dict[str, Any]) -> List[str]:
+    lines: List[str] = ["fleet wire:"]
+    for t in fleet["targets"]:
+        if not t.get("ok"):
+            lines.append(
+                f"  {t['transport']} {t['target']}: UNREACHABLE "
+                f"({t.get('error')})"
+            )
+            continue
+        wire = t.get("wire") or {}
+        ops = wire.get("ops") or {}
+        rpcs = sum(int(b.get("count") or 0) for b in ops.values())
+        parts = [f"{rpcs} rpc(s)", f"{len(ops)} op(s)"]
+        if wire.get("deadline_misses"):
+            parts.append(f"MISSES {wire['deadline_misses']}")
+        if wire.get("retries"):
+            parts.append(f"retries {wire['retries']}")
+        if wire.get("worst_margin_p99") is not None:
+            parts.append(
+                f"worst margin p99 {wire['worst_margin_p99']:.0%} "
+                f"({wire.get('worst_op')})"
+            )
+        lines.append(f"  {t['transport']} {t['target']}: " + ", ".join(parts))
+    if fleet["ops"]:
+        lines.append("  slowest ops (fleet-wide max p99):")
+        by_p99 = sorted(
+            fleet["ops"].items(),
+            key=lambda kv: float(kv[1].get("p99_s") or 0.0),
+            reverse=True,
+        )
+        for key, b in by_p99[:8]:
+            parts = [
+                f"n={b.get('count', 0)}",
+                f"p50 {float(b.get('p50_s') or 0) * 1000:.1f}ms",
+                f"p99 {float(b.get('p99_s') or 0) * 1000:.1f}ms",
+            ]
+            if b.get("margin_p99") is not None:
+                parts.append(f"margin p99 {b['margin_p99']:.0%}")
+            if b.get("deadline_misses"):
+                parts.append(f"MISSES {b['deadline_misses']}")
+            if b.get("retries"):
+                parts.append(f"retries {b['retries']}")
+            lines.append(f"    {key}: " + " ".join(parts))
+    return lines
 
 
 # -------------------------------------------------------------- rendering
@@ -302,8 +478,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "path",
+        nargs="?",
         help="snapshot/ledger URL (storage mode) or a local "
-        "TPUSNAPSHOT_PROGRESS_DIR directory (statusfile mode)",
+        "TPUSNAPSHOT_PROGRESS_DIR directory (statusfile mode); "
+        "optional in fleet wire mode (--wire / --wire-peers)",
+    )
+    parser.add_argument(
+        "--wire",
+        metavar="ADDR,ADDR",
+        help="fleet wire mode: comma-separated snapserve server "
+        "addresses to poll for their wiretap sample blocks",
+    )
+    parser.add_argument(
+        "--wire-peers",
+        metavar="ADDR,ADDR",
+        help="fleet wire mode: comma-separated snapwire hot-tier peer "
+        "addresses (host=addr entries also accepted) to poll",
+    )
+    parser.add_argument(
+        "--wire-timeout",
+        type=float,
+        default=10.0,
+        metavar="S",
+        help="per-target probe timeout for fleet wire mode (default 10s)",
     )
     parser.add_argument(
         "--stale-after",
@@ -326,6 +523,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("--json", action="store_true", help="emit JSON")
     args = parser.parse_args(argv)
+    wire_mode = bool(args.wire or args.wire_peers)
+    if not args.path and not wire_mode:
+        parser.error("a path is required (or --wire / --wire-peers)")
+    if wire_mode:
+        server_addrs = [
+            a.strip() for a in (args.wire or "").split(",") if a.strip()
+        ]
+        peer_addrs = [
+            # "host=addr" address-book entries are accepted for
+            # copy-paste parity with TPUSNAPSHOT_REPLICA_ADDRS specs.
+            a.strip().rpartition("=")[2]
+            for a in (args.wire_peers or "").split(",")
+            if a.strip()
+        ]
+        fleet = collect_fleet_wire(
+            server_addrs, peer_addrs, timeout_s=args.wire_timeout
+        )
+        wire_findings = fleet_wire_findings(fleet)
+        if args.json:
+            doc = dict(
+                fleet, findings=[f.as_dict() for f in wire_findings]
+            )
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print("\n".join(_render_fleet_wire(fleet)))
+            print()
+            print(render_findings(wire_findings))
+        if fleet["targets"] and fleet["reachable"] == 0:
+            return 2  # the fleet wire view itself is unavailable
+        return (
+            1
+            if any(f.severity == "critical" for f in wire_findings)
+            else 0
+        )
     stale_after = _watch._stale_after_s(args.stale_after)
     while True:
         try:

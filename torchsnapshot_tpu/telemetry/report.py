@@ -130,6 +130,18 @@ class FlightRecorder:
                 logger.debug(
                     "replication window open failed", exc_info=True
                 )
+        # Wire-observability window (wiretap/snapflight): opened for
+        # BOTH kinds — takes push over snapwire, restores read over
+        # snapserve — so the summary's ``wire`` block attributes every
+        # RPC this operation put on any transport. Best-effort by the
+        # same contract as the replication window.
+        self._wire_token: Any = None
+        try:
+            from torchsnapshot_tpu import wiretap
+
+            self._wire_token = wiretap.window_begin()
+        except Exception:
+            logger.debug("wire window open failed", exc_info=True)
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -236,6 +248,21 @@ class FlightRecorder:
                 block = None
             if block:
                 summary.setdefault("tier", {})["replication"] = block
+        if self._wire_token is not None:
+            # Close the wiretap window: per-op latency quantiles,
+            # deadline margin, retries, and outcome mix for every RPC
+            # this operation issued — what the deadline-margin-
+            # collapsing doctor rule and the ledger's wire field read.
+            # Absent when the window saw no wire traffic.
+            try:
+                from torchsnapshot_tpu import wiretap
+
+                wire_block = wiretap.window_collect(self._wire_token)
+            except Exception:
+                logger.debug("wire window collect failed", exc_info=True)
+                wire_block = None
+            if wire_block:
+                summary["wire"] = wire_block
         # Goodput attribution at summary time (present only once the
         # accountant saw a train loop or a checkpoint wait): the doctor's
         # checkpoint-overhead-above-budget rule and the ledger's goodput

@@ -172,6 +172,14 @@ class RuntimeSampler:
                 _goodput.snapshot() if _goodput.has_data() else None
             ),
         }
+        # Wire observability (wiretap/snapflight): cumulative per-op
+        # view — the slo live rule diffs consecutive samples for
+        # fresh deadline misses; absent when nothing crossed a wire.
+        from .. import wiretap
+
+        wire = wiretap.sample_block()
+        if wire.get("ops"):
+            sample["wire"] = wire
         return sample
 
     def sample_once(self) -> Optional[Dict[str, Any]]:

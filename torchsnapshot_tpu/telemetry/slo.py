@@ -56,7 +56,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.env import env_float
-from .doctor import Finding
+from .doctor import Finding, wire_pressure_finding
 
 # The dotted-field numeric getter lives in timeline; re-implementing it
 # here would be the package's third copy.
@@ -530,6 +530,51 @@ def rule_replication_underreplicated(
     )
 
 
+def rule_wire_deadline_pressure(
+    samples: List[Dict[str, Any]],
+) -> Optional[Finding]:
+    """snapflight: the wiretap sample block shows RPC latency eating
+    into per-op deadline budgets. The sampler's ``wire`` block carries
+    CUMULATIVE per-op counters, so with two or more wire-bearing
+    samples in the window the rule scores the DELTA (misses/retries
+    that happened inside the window — an old burst of misses must not
+    page forever); with a single sample it falls back to the absolute
+    block. Margin percentiles are not deltas — the latest sample's
+    p99 is used as-is (it already reflects recent shape). Severity and
+    thresholds are shared with the doctor's
+    ``deadline-margin-collapsing`` rule via
+    :func:`~.doctor.wire_pressure_finding`."""
+    wired = [
+        s["wire"]
+        for s in samples
+        if isinstance(s.get("wire"), dict) and s["wire"].get("ops")
+    ]
+    if not wired:
+        return None
+    latest = wired[-1]
+    ops: Dict[str, Dict[str, Any]] = {}
+    for key, stats in (latest.get("ops") or {}).items():
+        if isinstance(stats, dict):
+            ops[key] = dict(stats)
+    if not ops:
+        return None
+    if len(wired) >= 2:
+        first = wired[0].get("ops") or {}
+        for key, stats in ops.items():
+            base = first.get(key)
+            if not isinstance(base, dict):
+                continue
+            for field in ("count", "deadline_misses", "retries"):
+                delta = int(stats.get(field) or 0) - int(
+                    base.get(field) or 0
+                )
+                stats[field] = max(0, delta)
+        ops = {k: v for k, v in ops.items() if int(v.get("count") or 0) > 0}
+        if not ops:
+            return None
+    return wire_pressure_finding(ops, source="live")
+
+
 def evaluate_live(
     samples: List[Dict[str, Any]],
     budget_s: Optional[float] = None,
@@ -546,6 +591,7 @@ def evaluate_live(
             rule_drain_backlog_growing(samples),
             rule_durability_lag_live(samples, budget_s=budget_s),
             rule_replication_underreplicated(samples),
+            rule_wire_deadline_pressure(samples),
         )
         if f is not None
     ]
@@ -780,6 +826,67 @@ def _self_test() -> int:
         and "DEAD" in f.title
         for f in dead_blind
     ), dead_blind
+    # snapflight: wire deadline pressure over the sampler's wire block.
+    def wire_sample(count, misses=0, margin=0.2, retries=0):
+        return {
+            "wire": {
+                "ops": {
+                    "snapwire/put": {
+                        "count": count,
+                        "deadline_misses": misses,
+                        "retries": retries,
+                        "margin_p99": margin,
+                        "p99_s": margin * 2.0,
+                        "deadline_s": 2.0,
+                    }
+                },
+                "deadline_misses": misses,
+                "retries": retries,
+            }
+        }
+
+    healthy_wire = evaluate_live([wire_sample(10)])
+    assert not any(
+        f.rule == "deadline-margin-collapsing" for f in healthy_wire
+    ), healthy_wire
+    missed_wire = evaluate_live([wire_sample(10, misses=2)])
+    assert any(
+        f.rule == "deadline-margin-collapsing"
+        and f.severity == "critical"
+        for f in missed_wire
+    ), missed_wire
+    margin_wire = evaluate_live([wire_sample(10, margin=0.85)])
+    assert any(
+        f.rule == "deadline-margin-collapsing" and f.severity == "warn"
+        for f in margin_wire
+    ), margin_wire
+    # Counters are CUMULATIVE: misses before the window must not fire,
+    # and the windowed delta (not the running total) is the evidence.
+    old_burst = evaluate_live(
+        [wire_sample(100, misses=5), wire_sample(120, misses=5)]
+    )
+    assert not any(
+        f.rule == "deadline-margin-collapsing" for f in old_burst
+    ), old_burst
+    fresh_burst = [
+        f
+        for f in evaluate_live(
+            [wire_sample(100, misses=5), wire_sample(120, misses=8)]
+        )
+        if f.rule == "deadline-margin-collapsing"
+    ]
+    assert fresh_burst and fresh_burst[0].severity == "critical", (
+        fresh_burst
+    )
+    assert fresh_burst[0].evidence["deadline_misses"] == 3, fresh_burst
+    # A quiescent window (no new RPCs) is silent even with a sticky
+    # high margin_p99 from earlier traffic.
+    idle = evaluate_live(
+        [wire_sample(100, margin=0.95), wire_sample(100, margin=0.95)]
+    )
+    assert not any(
+        f.rule == "deadline-margin-collapsing" for f in idle
+    ), idle
     print("slo self-test OK")
     return 0
 
