@@ -166,12 +166,74 @@ class _HardDeadline(Exception):
 
 
 def _phase(name: str) -> None:
+    _mem_section_begin(name)
     _PHASE[0] = name
     print(
         f"[bench] phase {name} "
         f"({time.monotonic() - _BENCH_START[0]:.0f}s elapsed)",
         file=sys.stderr,
     )
+
+
+# Per-section host-memory accounting (snapmem satellite): every _phase
+# boundary closes the previous section's memwatch window and opens a
+# new one, so the BENCH JSON carries each section's domain high-waters
+# plus the process peak RSS — a restore that quietly doubled the
+# staging pool shows up in the artifact, not just on the host graph.
+_MEM_SECTION: list = [None]  # (name, memwatch window token, peak at start)
+
+
+def _peak_rss_bytes():
+    """Lifetime peak RSS via getrusage; None off-POSIX."""
+    try:
+        import resource
+
+        v = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    except Exception:  # snapcheck: disable=swallowed-exception -- resource module is POSIX-only
+        return None
+    # Linux reports KiB; macOS reports bytes. Treat small values as KiB.
+    return v if v > (1 << 32) else v * 1024
+
+
+def _mem_section_begin(name: str) -> None:
+    _mem_section_end()
+    try:
+        from torchsnapshot_tpu.telemetry import memwatch
+
+        token = memwatch.window_begin()
+    except Exception:  # snapcheck: disable=swallowed-exception -- memory accounting never fails the bench
+        token = None
+    _MEM_SECTION[0] = (name, token, _peak_rss_bytes())
+
+
+def _mem_section_end() -> None:
+    cur = _MEM_SECTION[0]
+    if cur is None:
+        return
+    _MEM_SECTION[0] = None
+    name, token, peak0 = cur
+    peak1 = _peak_rss_bytes()
+    entry: dict = {"peak_rss_bytes": peak1}
+    if peak0 is not None and peak1 is not None:
+        entry["peak_rss_growth_bytes"] = max(0, peak1 - peak0)
+    if token is not None:
+        try:
+            from torchsnapshot_tpu.telemetry import memwatch
+
+            block = memwatch.window_collect(token)
+        except Exception:  # snapcheck: disable=swallowed-exception -- memory accounting never fails the bench
+            block = None
+        if block:
+            entry["memwatch_high_water_bytes"] = block.get(
+                "high_water_bytes"
+            )
+            entry["domains"] = {
+                n: d.get("high_water_bytes")
+                for n, d in (block.get("domains") or {}).items()
+            }
+    mem = _RESULTS.setdefault("memory", {"sections": {}})
+    mem["sections"][name] = entry
+    mem["peak_rss_bytes"] = peak1
 
 
 def _remaining_s() -> float:
@@ -315,6 +377,7 @@ def _summary_doc() -> dict:
         "fleet": r.get("fleet"),
         "scaling": r.get("scaling"),
         "sharded_cpu": r.get("sharded_cpu"),
+        "memory": r.get("memory"),
         "gaps": r.get("gaps", []),
         "degraded": bool(r.get("degraded", True) or r.get("abort")),
         "abort": r.get("abort"),
@@ -328,6 +391,7 @@ def _emit_summary() -> None:
     if _EMITTED.is_set():
         return
     _EMITTED.set()
+    _mem_section_end()
     print(json.dumps(_summary_doc()))
     sys.stdout.flush()
 

@@ -239,6 +239,7 @@ def compare(
         )
     lines.extend(_consume_profile_notes(old, new))
     lines.extend(_wire_ops_notes(old, new))
+    lines.extend(_memory_notes(old, new))
     return lines, regressions
 
 
@@ -372,6 +373,76 @@ def _wire_ops_notes(
                 + " (see its blackbox dumps / doctor "
                 "deadline-margin-collapsing)"
             )
+    return notes
+
+
+# Host-memory shifts are reported as NOTES, never regressions: RSS on
+# a shared CI host is weather (allocator behaviour, import order, page
+# cache), and a memory regression gate belongs to the snapmem doctor
+# rules, not the throughput gate. The factors below keep the notes to
+# genuine shifts: peak RSS must grow by >=25% AND >=256 MiB; a domain's
+# fleet-of-sections high-water must grow by >=2x AND >=8 MiB.
+_MEM_RSS_SHIFT_FACTOR = 1.25
+_MEM_RSS_MIN_BYTES = 256 * 1024**2
+_MEM_DOMAIN_SHIFT_FACTOR = 2.0
+_MEM_DOMAIN_MIN_BYTES = 8 * 1024**2
+
+
+def _mem_domain_hwms(doc: Dict[str, Any]) -> Dict[str, int]:
+    """Per-domain memwatch high-water, maxed across the run's sections
+    (the bench records one window per section)."""
+    out: Dict[str, int] = {}
+    sections = ((doc.get("memory") or {}).get("sections")) or {}
+    for entry in sections.values():
+        for name, hwm in ((entry or {}).get("domains") or {}).items():
+            if isinstance(hwm, (int, float)):
+                out[name] = max(out.get(name, 0), int(hwm))
+    return out
+
+
+def _memory_notes(
+    old: Dict[str, Any], new: Dict[str, Any]
+) -> List[str]:
+    """Note lines (never regressions) on host-memory shifts between two
+    rounds (the snapmem ``memory`` block bench.py embeds): process peak
+    RSS growing past ``_MEM_RSS_SHIFT_FACTOR``, and a memwatch domain's
+    across-sections high-water growing past
+    ``_MEM_DOMAIN_SHIFT_FACTOR``. Memory is diagnosis here — the gating
+    lives in the snapmem doctor/slo rules and the leak sentinel."""
+    notes: List[str] = []
+    a_rss = ((old.get("memory") or {}).get("peak_rss_bytes"))
+    b_rss = ((new.get("memory") or {}).get("peak_rss_bytes"))
+    if (
+        isinstance(a_rss, (int, float))
+        and isinstance(b_rss, (int, float))
+        and a_rss > 0
+        and b_rss >= a_rss * _MEM_RSS_SHIFT_FACTOR
+        and b_rss - a_rss >= _MEM_RSS_MIN_BYTES
+    ):
+        notes.append(
+            f"note: peak RSS grew {a_rss / 1024**2:.0f}MB -> "
+            f"{b_rss / 1024**2:.0f}MB "
+            f"({100 * (b_rss - a_rss) / a_rss:+.0f}%) — check the "
+            f"NEW run's per-section memory block / snapmem doctor"
+        )
+    a_dom, b_dom = _mem_domain_hwms(old), _mem_domain_hwms(new)
+    shifted = []
+    for name in sorted(set(a_dom) & set(b_dom)):
+        a, b = a_dom[name], b_dom[name]
+        if (
+            a > 0
+            and b >= a * _MEM_DOMAIN_SHIFT_FACTOR
+            and b - a >= _MEM_DOMAIN_MIN_BYTES
+        ):
+            shifted.append(
+                f"{name} {a / 1024**2:.0f}MB->{b / 1024**2:.0f}MB"
+            )
+    if shifted:
+        notes.append(
+            "note: memory-domain high-water shifted: "
+            + ", ".join(shifted)
+            + " (max across bench sections; see `ops --mem`)"
+        )
     return notes
 
 
@@ -643,6 +714,41 @@ def _self_test() -> int:
     lines, _ = compare(tiny, tiny_slow, 0.2)
     assert not any("latency shifted" in ln for ln in lines), (
         f"under-sampled ops must not earn latency notes: {lines}"
+    )
+    # Snapmem memory notes: peak-RSS growth and domain high-water
+    # growth are NOTES, never regressions; small churn stays silent.
+    def _mem(rss_mb, pool_mb):
+        return {
+            "peak_rss_bytes": rss_mb * 1024**2,
+            "sections": {
+                "restore": {
+                    "peak_rss_bytes": rss_mb * 1024**2,
+                    "domains": {"staging_pool": pool_mb * 1024**2},
+                }
+            },
+        }
+
+    ma = dict(base, memory=_mem(1000, 64))
+    lines, reg = compare(ma, dict(base, memory=_mem(2000, 64)), 0.2)
+    assert not reg, f"RSS doubling must never regress the gate: {reg}"
+    assert any("peak RSS grew" in ln for ln in lines), lines
+    lines, reg = compare(ma, dict(base, memory=_mem(1100, 64)), 0.2)
+    assert not any("peak RSS" in ln for ln in lines), (
+        f"10% RSS churn must stay silent: {lines}"
+    )
+    lines, reg = compare(ma, dict(base, memory=_mem(1000, 200)), 0.2)
+    assert not reg, f"domain hwm growth must never regress: {reg}"
+    assert any(
+        "memory-domain high-water shifted" in ln and "staging_pool" in ln
+        for ln in lines
+    ), lines
+    lines, _ = compare(ma, dict(ma), 0.2)
+    assert not any("note: " in ln and "memory" in ln for ln in lines), (
+        f"identical memory blocks must stay silent: {lines}"
+    )
+    lines, reg = compare(base, ma, 0.2)
+    assert not reg and not any("RSS" in ln for ln in lines), (
+        f"memory block absent on one side is skipped: {lines}"
     )
     print("bench_compare self-test OK")
     return 0

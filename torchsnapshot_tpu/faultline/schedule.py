@@ -131,6 +131,7 @@ class FaultRule:
     #          | "killmember" | "slowmember"  (snapfleet: one NAMED member)
     #          | "drop_conn" | "torn_frame" | "slow_wire"  (snapwire)
     #          | "flap"  (snapmend: lose-then-revive churn)
+    #          | "mem_pressure"  (snapmem: shrink a memory domain's cap)
     op: str = "*"
     path: str = "*"
     nth: int = 1
@@ -145,6 +146,9 @@ class FaultRule:
     # host comes back (a wire-backed peer as a FRESH subprocess one
     # membership generation up; an in-process host empty).
     revive_after_ops: Optional[int] = None
+    # mem_pressure: which memwatch domain shrinks, and to what cap.
+    domain: Optional[str] = None
+    cap_bytes: Optional[int] = None
     _hits: int = field(default=0, repr=False)
     _fired: int = field(default=0, repr=False)
 
@@ -278,6 +282,33 @@ class FaultSchedule:
             nth=nth,
             times=times,
         )
+
+    def mem_pressure(
+        self,
+        domain: str,
+        cap_bytes: int,
+        op: str = "*",
+        path: str = "*",
+        nth: int = 1,
+    ) -> "FaultSchedule":
+        """snapmem: at the ``nth`` matching op boundary, shrink the
+        REPORTED cap of the named memwatch domain (``"staging_pool"``,
+        ``"snapserve.cache"``, ...) to ``cap_bytes`` via
+        :func:`~torchsnapshot_tpu.telemetry.memwatch.force_cap`. The
+        subsystem's real budget is untouched — occupancy simply lands
+        above the shrunk cap, so the doctor's
+        ``host-memory-overcommit`` rule (and the slo live memory rule)
+        trip deterministically in tests, exactly as they would on a
+        host whose real limit came down under the workload
+        (docs/FAULTS.md). Cleared by ``memwatch.reset()`` /
+        ``clear_cap_overrides()``."""
+        self.rules.append(
+            FaultRule(
+                kind="mem_pressure", op=op, path=path, nth=nth, times=1,
+                domain=domain, cap_bytes=int(cap_bytes),
+            )
+        )
+        return self
 
     def kill_server(
         self, op: str = "snapserve.request", path: str = "*", nth: int = 1
@@ -640,6 +671,18 @@ class FaultController:
                     # put dials).
                     transport.script_wire_fault(
                         rule.kind, host=rule.host, seconds=rule.seconds
+                    )
+                    continue
+                if rule.kind == "mem_pressure":
+                    self._record(idx, op, path, "mem_pressure")
+                    from ..telemetry import memwatch
+
+                    # Shrink the reported cap; never raises into the
+                    # guarded op — the fault is the observability
+                    # plane's problem to NOTICE, not the pipeline's to
+                    # trip over.
+                    memwatch.force_cap(
+                        rule.domain or "", int(rule.cap_bytes or 0)
                     )
                     continue
                 if rule.kind == "killserver":

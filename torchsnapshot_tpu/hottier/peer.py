@@ -484,6 +484,17 @@ class PeerServer:
             logger.debug(
                 "hottier.peer: wiretap sample failed", exc_info=True
             )
+        # The memory plane rides the same op (`ops --mem` fleet table).
+        try:
+            from ..telemetry import memwatch
+
+            mem = memwatch.sample_block()
+            if mem.get("domains"):
+                resp["memory"] = mem
+        except Exception:  # pragma: no cover - defensive
+            logger.debug(
+                "hottier.peer: memwatch sample failed", exc_info=True
+            )
         return resp, b""
 
     def _do_ping(

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 
 from .. import telemetry
 from ..fingerprint import fingerprint_host
+from ..telemetry import memwatch
 from ..telemetry import metrics as _metric_names
 
 import logging
@@ -780,3 +781,50 @@ def host_occupancy() -> Dict[int, Dict[str, object]]:
                 "remote": True,
             }
         return dict(sorted(out.items()))
+
+
+# ----------------------------------------------------------- snapmem
+#
+# Polled memory domains (providers, not push handles): the tier mutates
+# its stores at dozens of call sites under _TIER_LOCK, so snapmem polls
+# a one-pass aggregate at snapshot time instead of instrumenting each.
+# "hottier.host" is the local stores' real host RAM (undrained bytes
+# pinned — evicting them would orphan committed bytes); the remote
+# shadow is the client-side LEDGER of replicas parked on peer
+# processes: real bytes, but not ours, so the domain is external
+# (visible in the table, excluded from this process's committed/
+# headroom math — the owning peer registers them itself).
+
+
+def _mem_hosts_provider():
+    with _TIER_LOCK:
+        used = 0
+        pinned = 0
+        cap = 0
+        for store in _HOSTS.values():
+            used += store.used_bytes
+            cap += store.capacity_bytes
+            pinned += sum(
+                len(o.data)
+                for o in store.objects.values()
+                if not o.drained
+            )
+        return used, pinned, (cap if _HOSTS else None)
+
+
+def _mem_shadow_provider():
+    with _TIER_LOCK:
+        used = 0
+        pinned = 0
+        for s in _REMOTE_SHADOW.values():
+            n = int(s["nbytes"])
+            used += n
+            if not s["drained"]:
+                pinned += n
+        return used, pinned, None
+
+
+memwatch.register_provider("hottier.host", _mem_hosts_provider)
+memwatch.register_provider(
+    "hottier.shadow", _mem_shadow_provider, external=True
+)

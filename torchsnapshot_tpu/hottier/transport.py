@@ -1029,6 +1029,20 @@ class RemotePeer:
         block = resp.get("wire") if resp.get("ok") else None
         return block if isinstance(block, dict) else None
 
+    def mem_stats(self) -> Optional[Dict[str, Any]]:
+        """The peer's snapmem ``memory`` sample block (piggybacked on
+        the ``stats`` op like :meth:`wire_stats`) — `ops --mem`'s
+        fleet-wide memory table reads this. Best-effort probe."""
+        try:
+            resp, _ = self._call(
+                {"v": wire.PROTOCOL_VERSION, "op": "stats"},
+                best_effort=True,
+            )
+        except HostLostError:
+            return None
+        block = resp.get("memory") if resp.get("ok") else None
+        return block if isinstance(block, dict) else None
+
 
 # --------------------------------------------------------- registration
 

@@ -49,6 +49,7 @@ import psutil
 from . import telemetry, tracing
 from .io_types import IOReq, ReadReq, StoragePlugin, WriteReq, io_payload
 from .telemetry import consume_profile as _cprof
+from .telemetry import memwatch
 from .telemetry import metrics as _metric_names
 
 logger = logging.getLogger(__name__)
@@ -215,6 +216,27 @@ async def execute_write_reqs(
     stalled_gauge = telemetry.gauge(
         _metric_names.SCHED_BUDGET_STALLED, pipeline="write"
     )
+    # snapmem: the write budget is transient host RAM — staged buffers
+    # live only between stage and write completion, so any residual
+    # after the pipeline exits is a leak signal. Pre-storm forecast:
+    # the allocation burst is bounded by min(total staging cost,
+    # budget) since dispatch throttles at the budget line.
+    mem_domain = memwatch.register(
+        "scheduler.write",
+        cap_bytes=memory_budget_bytes,
+        transient=True,
+        watch_residual="used",
+    )
+    memwatch.forecast(
+        min(
+            sum(
+                wr.buffer_stager.get_staging_cost_bytes()
+                for wr in write_reqs
+            ),
+            memory_budget_bytes,
+        ),
+        kind="take",
+    )
     try:
         while pending or staged or staging or io_tasks:
             # Dispatch staging while the budget allows; always keep at
@@ -291,6 +313,10 @@ async def execute_write_reqs(
                 io_tasks[task] = len(buf)
 
             in_use_gauge.set(memory_budget_bytes - budget)
+            mem_domain.set_used(
+                max(0, memory_budget_bytes - budget),
+                pinned_bytes=max(0, memory_budget_bytes - budget),
+            )
             stalled_gauge.set(1.0 if budget_blocked else 0.0)
             in_flight = set(staging) | set(io_tasks)
             if not in_flight:
@@ -320,6 +346,8 @@ async def execute_write_reqs(
         executor.shutdown(wait=False)
         in_use_gauge.set(0)
         stalled_gauge.set(0)
+        mem_domain.set_used(max(0, memory_budget_bytes - budget))
+        mem_domain.close()
     elapsed = time.monotonic() - begin_ts
     _merge_stats(
         stats,
@@ -473,6 +501,33 @@ async def execute_read_reqs(
     stalled_gauge = telemetry.gauge(
         _metric_names.SCHED_BUDGET_STALLED, pipeline="read"
     )
+    # snapmem: host-cell bytes are transient host RAM; the device cell
+    # tracks HBM deposits — real bytes, but not host RAM, so it is
+    # registered external (visible in the domain table, excluded from
+    # the committed/headroom math). Forecast the host-side burst before
+    # the read storm starts.
+    mem_domain = memwatch.register(
+        "scheduler.read.host",
+        cap_bytes=memory_budget_bytes,
+        transient=True,
+        watch_residual="used",
+    )
+    mem_device_domain = memwatch.register(
+        "scheduler.read.device",
+        cap_bytes=device_budget_bytes,
+        transient=True,
+        external=True,
+    )
+    memwatch.forecast(
+        min(
+            sum(
+                r.buffer_consumer.get_consuming_cost_bytes()
+                for r in read_reqs
+            ),
+            memory_budget_bytes,
+        ),
+        kind="restore",
+    )
     try:
         while pending or reading or consumable or consuming:
             budget_blocked = False
@@ -594,6 +649,17 @@ async def execute_read_reqs(
                 consuming[consume_task] = host_refund
 
             in_use_gauge.set(memory_budget_bytes - budget.value)
+            mem_domain.set_used(
+                max(0, memory_budget_bytes - budget.value),
+                pinned_bytes=max(0, memory_budget_bytes - budget.value),
+            )
+            if device_budget_bytes is not None:
+                mem_device_domain.set_used(
+                    max(0, device_budget_bytes - device_budget.value),
+                    pinned_bytes=max(
+                        0, device_budget_bytes - device_budget.value
+                    ),
+                )
             stalled_gauge.set(1.0 if budget_blocked else 0.0)
             in_flight = set(reading) | set(consuming)
             if not in_flight:
@@ -620,6 +686,9 @@ async def execute_read_reqs(
         executor.shutdown(wait=False)
         in_use_gauge.set(0)
         stalled_gauge.set(0)
+        mem_domain.set_used(max(0, memory_budget_bytes - budget.value))
+        mem_domain.close()
+        mem_device_domain.close()
     elapsed = time.monotonic() - begin_ts
     _merge_stats(
         stats,

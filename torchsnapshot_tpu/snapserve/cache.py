@@ -17,9 +17,14 @@ every instant (tests/test_snapserve.py hammers this from 16 threads).
 """
 
 import threading
+import weakref
 import zlib
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
+
+from .. import telemetry
+from ..telemetry import memwatch
+from ..telemetry import metrics as _metric_names
 
 
 def content_fingerprint(data: bytes) -> str:
@@ -36,6 +41,7 @@ class ByteLRU:
         self.cap_bytes = max(0, int(cap_bytes))
         self._entries: "OrderedDict[str, Tuple[bytes, str]]" = OrderedDict()
         self._bytes_used = 0
+        self._high_water_bytes = 0
         self._lock = threading.Lock()
         self._stats: Dict[str, int] = {
             "hits": 0,
@@ -45,6 +51,14 @@ class ByteLRU:
             "inserts": 0,
             "oversize_skips": 0,
         }
+        # snapmem: cache bytes are evictable by definition (pinned=0)
+        # and retention is the point — no residual tracking. Several
+        # ByteLRUs in one process (multi-server tests) aggregate under
+        # the one domain name.
+        self._mem_domain = memwatch.register(
+            "snapserve.cache", cap_bytes=self.cap_bytes
+        )
+        weakref.finalize(self, self._mem_domain.close)
 
     def get(self, key: str) -> Optional[bytes]:
         """The cached payload, fingerprint-verified, or None. A failed
@@ -54,6 +68,7 @@ class ByteLRU:
             entry = self._entries.get(key)
             if entry is None:
                 self._stats["misses"] += 1
+                self._mem_domain.counter("misses")
                 return None
             data, tag = entry
             if content_fingerprint(data) != tag:
@@ -61,9 +76,12 @@ class ByteLRU:
                 self._bytes_used -= len(data)
                 self._stats["corrupt"] += 1
                 self._stats["misses"] += 1
+                self._mem_domain.counter("misses")
+                self._publish_locked()
                 return None
             self._entries.move_to_end(key)
             self._stats["hits"] += 1
+            self._mem_domain.counter("hits")
             return data
 
     def put(self, key: str, data: bytes) -> bool:
@@ -81,9 +99,15 @@ class ByteLRU:
                 _, (evicted, _tag) = self._entries.popitem(last=False)
                 self._bytes_used -= len(evicted)
                 self._stats["evictions"] += 1
+                self._mem_domain.counter("evictions")
             self._entries[key] = (bytes(data), content_fingerprint(data))
             self._bytes_used += size
+            self._high_water_bytes = max(
+                self._high_water_bytes, self._bytes_used
+            )
             self._stats["inserts"] += 1
+            self._mem_domain.counter("inserts")
+            self._publish_locked()
             return True
 
     def corrupt_for_test(self, key: str) -> bool:
@@ -98,6 +122,18 @@ class ByteLRU:
             mangled = bytes([data[0] ^ 0xFF]) + data[1:]
             self._entries[key] = (mangled, tag)
             return True
+
+    def _publish_locked(self) -> None:
+        """Mirror occupancy into the gauges and the snapmem domain
+        after every byte-moving transition (lock held; the high-water
+        mutation lives at the byte-raising site in ``put``)."""
+        telemetry.gauge(_metric_names.SNAPSERVE_CACHE_BYTES).set(
+            float(self._bytes_used)
+        )
+        telemetry.gauge(_metric_names.SNAPSERVE_CACHE_HWM).set(
+            float(self._high_water_bytes)
+        )
+        self._mem_domain.set_used(self._bytes_used, pinned_bytes=0)
 
     @property
     def bytes_used(self) -> int:
@@ -114,4 +150,5 @@ class ByteLRU:
             out["bytes_used"] = self._bytes_used
             out["entries"] = len(self._entries)
             out["cap_bytes"] = self.cap_bytes
+            out["high_water_bytes"] = self._high_water_bytes
             return out

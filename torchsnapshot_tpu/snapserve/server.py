@@ -56,10 +56,12 @@ import collections
 import logging
 import threading
 import time
+import weakref
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from .. import telemetry, tracing, wiretap
 from ..io_types import IOReq, StoragePlugin, io_payload
+from ..telemetry import memwatch
 from ..telemetry import metrics as _metric_names
 from ..utils.env import env_float, env_int
 from .cache import ByteLRU, content_fingerprint
@@ -137,6 +139,16 @@ class _ClientGate:
         self._cap = max(1, cap_bytes)
         self._outstanding = 0
         self._cond = asyncio.Condition()
+        # snapmem: in-flight response bytes, all pinned (the write is
+        # draining them) and transient — a residual after the
+        # connection quiesces is a leaked release.
+        self._mem_domain = memwatch.register(
+            "snapserve.flow",
+            cap_bytes=self._cap,
+            transient=True,
+            watch_residual="used",
+        )
+        weakref.finalize(self, self._mem_domain.close)
 
     async def acquire(self, nbytes: int) -> None:
         begin = time.monotonic()
@@ -146,6 +158,9 @@ class _ClientGate:
             ):
                 await self._cond.wait()
             self._outstanding += nbytes
+            self._mem_domain.set_used(
+                self._outstanding, pinned_bytes=self._outstanding
+            )
         waited = time.monotonic() - begin
         if waited > 0.001:
             telemetry.counter(
@@ -155,6 +170,10 @@ class _ClientGate:
     async def release(self, nbytes: int) -> None:
         async with self._cond:
             self._outstanding -= nbytes
+            self._mem_domain.set_used(
+                max(0, self._outstanding),
+                pinned_bytes=max(0, self._outstanding),
+            )
             self._cond.notify_all()
 
 
@@ -196,6 +215,19 @@ class TenantAdmission:
                 }
             )
         )
+        # snapmem: total in-flight bytes across every tenant. The quota
+        # is PER TENANT — there is no aggregate cap (two tenants may
+        # legitimately sum past one quota), so the domain reports none.
+        self._mem_domain = memwatch.register(
+            "snapserve.tenant",
+            transient=True,
+            watch_residual="used",
+        )
+        weakref.finalize(self, self._mem_domain.close)
+
+    def _publish_mem_locked(self) -> None:
+        total = sum(self._inflight.values())
+        self._mem_domain.set_used(max(0, total), pinned_bytes=max(0, total))
 
     def _tstats(self, tenant: str) -> Dict[str, Any]:
         # Lock held by caller; the defaultdict materializes the entry.
@@ -215,6 +247,7 @@ class TenantAdmission:
                 self._inflight[tenant] = (
                     self._inflight.get(tenant, 0) + nbytes
                 )
+                self._publish_mem_locked()
                 # Immediate grants count as 0-wait samples so a
                 # never-deferred tenant has a defined grant-wait p95
                 # (the fairness bench compares tenants' p95s).
@@ -247,6 +280,7 @@ class TenantAdmission:
                     self._inflight[tenant] = max(
                         0, self._inflight.get(tenant, 0) - nbytes
                     )
+                    self._publish_mem_locked()
                     grants = self._pump_locked()
             for g in grants:
                 if not g.done():
@@ -267,6 +301,7 @@ class TenantAdmission:
             self._inflight[tenant] = max(
                 0, self._inflight.get(tenant, 0) - nbytes
             )
+            self._publish_mem_locked()
             grants = self._pump_locked()
         for fut in grants:
             if not fut.done():
@@ -299,6 +334,7 @@ class TenantAdmission:
                         self._inflight[tenant] = (
                             self._inflight.get(tenant, 0) + nbytes
                         )
+                        self._publish_mem_locked()
                         granted.append(fut)
                         progressed = True
                 if not progressed:
@@ -1161,6 +1197,14 @@ class SnapServer:
                 stats["wire"] = block
         except Exception:  # pragma: no cover - defensive
             logger.debug("snapserve: wiretap sample failed", exc_info=True)
+        # The memory plane rides the same op: this process's snapmem
+        # domain table (cache, flow, tenants, ...) for `ops --mem`.
+        try:
+            mem = memwatch.sample_block()
+            if mem.get("domains"):
+                stats["memory"] = mem
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("snapserve: memwatch sample failed", exc_info=True)
         return {"stats": stats}, b""
 
     async def _op_ping(

@@ -40,12 +40,14 @@ Env knobs:
 
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from . import telemetry
 from .telemetry import consume_profile as _cprof
+from .telemetry import memwatch
 from .telemetry import metrics as _metric_names
 from .utils.env import env_float, env_int
 
@@ -146,6 +148,18 @@ class StagingPool:
         self._free: Dict[int, List[bytearray]] = {}
         self._free_bytes = 0
         self._in_use_bytes = 0
+        self._high_water_bytes = 0
+        # snapmem: retained + leased bytes against the pool cap. Leased
+        # bytes are pinned (a live restore holds them); retained free
+        # buffers are evictable by design. Residual tracking watches
+        # the pinned side — free buffers are retention, leaked LEASES
+        # are the drift the sentinel must name.
+        self._mem_domain = memwatch.register(
+            "staging_pool",
+            cap_bytes=capacity_bytes,
+            watch_residual="pinned",
+        )
+        weakref.finalize(self, self._mem_domain.close)
 
     # ------------------------------------------------------------ acquire
     def acquire(
@@ -176,14 +190,18 @@ class StagingPool:
                         self._cond.wait(remaining)
                         buf = self._take_free_locked(nbytes)
                 telemetry.counter(_metric_names.RESTORE_POOL_WAITS).inc(1)
+                self._mem_domain.counter("waits")
                 if buf is None:
                     buf = self._take_free_locked(nbytes)
             if buf is None:
                 buf = bytearray(nbytes)
                 telemetry.counter(_metric_names.RESTORE_POOL_MISSES).inc(1)
+                self._mem_domain.counter("misses")
             else:
                 telemetry.counter(_metric_names.RESTORE_POOL_HITS).inc(1)
+                self._mem_domain.counter("hits")
             self._in_use_bytes += nbytes
+            self._publish_locked()
         return StagingLease(self, buf, nbytes)
 
     def _take_free_locked(self, nbytes: int) -> Optional[bytearray]:
@@ -233,10 +251,25 @@ class StagingPool:
             if self._free_bytes + nbytes <= self.capacity_bytes:
                 self._free.setdefault(nbytes, []).append(buffer)
                 self._free_bytes += nbytes
-            telemetry.gauge(_metric_names.RESTORE_POOL_RETAINED).set(
-                float(self._free_bytes)
-            )
+            self._publish_locked()
             self._cond.notify_all()
+
+    def _publish_locked(self) -> None:
+        """Mirror occupancy into the gauges and the snapmem domain
+        (retained+leased vs cap, leases pinned). Called with the pool
+        condition held after every byte-moving transition."""
+        total = self._free_bytes + self._in_use_bytes
+        self._high_water_bytes = max(self._high_water_bytes, total)
+        telemetry.gauge(_metric_names.RESTORE_POOL_RETAINED).set(
+            float(self._free_bytes)
+        )
+        telemetry.gauge(_metric_names.RESTORE_POOL_LEASED).set(
+            float(self._in_use_bytes)
+        )
+        telemetry.gauge(_metric_names.RESTORE_POOL_HWM).set(
+            float(self._high_water_bytes)
+        )
+        self._mem_domain.set_used(total, pinned_bytes=self._in_use_bytes)
 
     # ------------------------------------------------------------- stats
     def stats(self) -> Dict[str, int]:
@@ -245,6 +278,7 @@ class StagingPool:
                 "free_bytes": self._free_bytes,
                 "in_use_bytes": self._in_use_bytes,
                 "capacity_bytes": self.capacity_bytes,
+                "high_water_bytes": self._high_water_bytes,
             }
 
 
@@ -266,4 +300,7 @@ def get_staging_pool() -> Optional[StagingPool]:
 def reset_staging_pool() -> None:
     """Drop the memoized pool (tests re-read the env knobs)."""
     with _pool_lock:
+        for pool in _pool:
+            if pool is not None:
+                pool._mem_domain.close()
         _pool.clear()

@@ -99,9 +99,9 @@ import argparse
 import json
 import sys
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils.env import env_float
+from ..utils.env import env_float, env_int
 
 # Ratio thresholds, shared with summarize's dominance verdict where the
 # same question is asked of a trace instead of a report.
@@ -1066,6 +1066,319 @@ def _rule_deadline_margin_collapsing(
     return wire_pressure_finding(ops, source="report")
 
 
+# -------------------------------------------------- host memory (snapmem)
+#
+# The memory rules read memwatch blocks — flight-report ``memory``
+# windows post-hoc (the _rule_* wrappers below), sampler ``memory``
+# blocks live (telemetry/slo.py), fleet stats RPC blocks (ops --mem) —
+# through the two shared helpers, so every surface renders the same
+# verdict for the same numbers.
+
+# Cache-misfit heuristics only speak once the cache saw real traffic.
+_CACHE_MIN_LOOKUPS = 20
+
+
+def memory_pressure_finding(
+    mem: Dict[str, Any], source: str = "report"
+) -> Optional[Finding]:
+    """The shared ``host-memory-overcommit`` verdict over one memwatch
+    block (flight-report window, sampler sample, or fleet stats).
+
+    Critical when committed bytes actually landed past a limit — a
+    domain's high-water above its cap, or the aggregate high-water
+    past the host budget. Warn when only the pre-storm forecast
+    predicted an overcommit (the storm may still have fit — RSS
+    headroom is elastic; the point is to say so BEFORE the OOM
+    killer does)."""
+    if not mem:
+        return None
+    over_domains: List[Dict[str, Any]] = []
+    for name, d in sorted((mem.get("domains") or {}).items()):
+        if not isinstance(d, dict) or d.get("cap_bytes") is None:
+            continue
+        hwm = int(
+            d.get("high_water_bytes")
+            if d.get("high_water_bytes") is not None
+            else d.get("used_bytes") or 0
+        )
+        cap = int(d["cap_bytes"])
+        if hwm > cap:
+            over_domains.append(
+                {"domain": name, "high_water_bytes": hwm, "cap_bytes": cap}
+            )
+    budget = mem.get("budget_bytes")
+    agg_hwm = int(mem.get("high_water_bytes") or 0)
+    budget_over = budget is not None and agg_hwm > int(budget)
+    forecasts = mem.get("forecasts")
+    n_forecasts = (
+        len(forecasts)
+        if isinstance(forecasts, list)
+        else int(forecasts or 0)
+    )
+    if not over_domains and not budget_over and not n_forecasts:
+        return None
+    evidence: Dict[str, Any] = {
+        "source": source,
+        "high_water_bytes": agg_hwm,
+        "budget_bytes": budget,
+    }
+    if over_domains:
+        evidence["over_cap_domains"] = over_domains[:5]
+    if n_forecasts:
+        evidence["overcommit_forecasts"] = n_forecasts
+    if over_domains:
+        worst = over_domains[0]
+        title = (
+            f"domain {worst['domain']} high-water "
+            f"{worst['high_water_bytes']} bytes exceeds its "
+            f"{worst['cap_bytes']}-byte cap"
+        )
+        severity = "critical"
+    elif budget_over:
+        title = (
+            f"committed host memory high-water {agg_hwm} bytes exceeds "
+            f"the {budget}-byte host budget"
+        )
+        severity = "critical"
+    else:
+        title = (
+            f"{n_forecasts} pre-storm forecast(s) predicted the "
+            f"operation's byte demand would not fit live host headroom"
+        )
+        severity = "warn"
+    return Finding(
+        rule="host-memory-overcommit",
+        severity=severity,
+        title=title,
+        evidence=evidence,
+        remediation=(
+            "the process's byte-capped domains are collectively "
+            "promising more host RAM than the host gives. Lower the "
+            "overcommitting domain's cap (scheduler "
+            "TPUSNAPSHOT_PER_RANK_MEMORY_BUDGET_BYTES, pool "
+            "TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES, snapserve cache/"
+            "flow knobs), or raise/verify TPUSNAPSHOT_HOST_MEM_BUDGET "
+            "if the detected limit is wrong. `ops --mem` shows which "
+            "process and domain is the offender."
+        ),
+    )
+
+
+def cache_misfit_finding(
+    cache: Dict[str, Any], source: str = "report"
+) -> Optional[Finding]:
+    """The shared ``cache-cap-misfit`` verdict over ByteLRU counters
+    (windowed deltas from a memory block, or cumulative server stats).
+
+    Warn on THRASH — the cache runs at its cap while evicting nearly
+    as fast as it inserts with a sub-50% hit ratio (the cap is too
+    small for the working set) — and on OVERSIZE — plenty of traffic
+    but occupancy never reached a quarter of the cap (RAM promised to
+    a cache that does not need it)."""
+    if not cache:
+        return None
+    hits = int(cache.get("hits") or 0)
+    misses = int(cache.get("misses") or 0)
+    evictions = int(cache.get("evictions") or 0)
+    inserts = int(cache.get("inserts") or 0)
+    lookups = hits + misses
+    cap = cache.get("cap_bytes")
+    hwm = int(cache.get("high_water_bytes") or 0)
+    if lookups < _CACHE_MIN_LOOKUPS or not cap:
+        return None
+    cap = int(cap)
+    hit_ratio = hits / lookups
+    evidence = {
+        "source": source,
+        "hits": hits,
+        "misses": misses,
+        "evictions": evictions,
+        "inserts": inserts,
+        "hit_ratio": round(hit_ratio, 3),
+        "cap_bytes": cap,
+        "high_water_bytes": hwm,
+    }
+    if (
+        hwm >= 0.95 * cap
+        and hit_ratio < 0.5
+        and inserts > 0
+        and evictions >= 0.5 * inserts
+    ):
+        return Finding(
+            rule="cache-cap-misfit",
+            severity="warn",
+            title=(
+                f"read cache is thrashing: {hit_ratio:.0%} hit ratio at "
+                f"a full {cap}-byte cap with {evictions} evictions "
+                f"against {inserts} inserts"
+            ),
+            evidence=evidence,
+            remediation=(
+                "the working set does not fit the cache — entries are "
+                "evicted before they are re-read. Raise "
+                "TPUSNAPSHOT_SNAPSERVE_CACHE_BYTES (watch `ops --mem` "
+                "headroom first), or accept backend re-reads if RAM is "
+                "the scarcer resource."
+            ),
+        )
+    if hwm < 0.25 * cap and lookups >= 2 * _CACHE_MIN_LOOKUPS:
+        return Finding(
+            rule="cache-cap-misfit",
+            severity="warn",
+            title=(
+                f"read cache cap is oversized: occupancy never passed "
+                f"{hwm} bytes of a {cap}-byte cap across "
+                f"{lookups} lookups"
+            ),
+            evidence=evidence,
+            remediation=(
+                "the cap promises RAM the working set never uses — "
+                "lower TPUSNAPSHOT_SNAPSERVE_CACHE_BYTES and give the "
+                "headroom back to the host budget."
+            ),
+        )
+    return None
+
+
+def _merged_memory(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge per-rank memory windows for the rule wrappers: per-domain
+    high-waters/residuals take the worst rank, the aggregate high-water
+    takes the worst rank, forecasts sum."""
+    merged: Dict[str, Any] = {"domains": {}}
+    agg = 0
+    budget = None
+    forecasts = 0
+    seen = False
+    for s in _ranks(report):
+        mem = s.get("memory")
+        if not mem:
+            continue
+        seen = True
+        for name, d in (mem.get("domains") or {}).items():
+            if not isinstance(d, dict):
+                continue
+            acc = merged["domains"].setdefault(name, {})
+            for k in ("high_water_bytes", "residual_bytes"):
+                if d.get(k) is not None:
+                    acc[k] = max(int(acc.get(k) or 0), int(d[k]))
+            if d.get("cap_bytes") is not None:
+                acc["cap_bytes"] = int(d["cap_bytes"])
+            for ck, cv in (d.get("counters") or {}).items():
+                counters = acc.setdefault("counters", {})
+                counters[ck] = int(counters.get(ck, 0)) + int(cv)
+        agg = max(agg, int(mem.get("high_water_bytes") or 0))
+        if mem.get("budget_bytes") is not None:
+            b = int(mem["budget_bytes"])
+            budget = b if budget is None else min(budget, b)
+        forecasts += len(mem.get("forecasts") or [])
+    if not seen:
+        return {}
+    merged["high_water_bytes"] = agg
+    merged["budget_bytes"] = budget
+    if forecasts:
+        merged["forecasts"] = forecasts
+    return merged
+
+
+def _rule_host_memory_overcommit(
+    report: Dict[str, Any]
+) -> Optional[Finding]:
+    return memory_pressure_finding(
+        _merged_memory(report), source="report"
+    )
+
+
+def _rule_memory_leak(report: Dict[str, Any]) -> Optional[Finding]:
+    # Single-report residual check: a completed operation whose
+    # residual-watched domain still holds real bytes. The cross-record
+    # TREND (the sentinel proper) lives in memwatch.leak_findings over
+    # a ledger series; this rule catches the egregious single-shot
+    # case — bytes a finished take/restore plainly never gave back.
+    from .memwatch import LEAK_MIN_BYTES_ENV_VAR
+
+    floor = env_int(LEAK_MIN_BYTES_ENV_VAR, 1 << 20)
+    merged = _merged_memory(report)
+    worst: Optional[Tuple[int, str]] = None
+    for name, d in sorted((merged.get("domains") or {}).items()):
+        residual = d.get("residual_bytes")
+        if residual is not None and int(residual) >= max(1, floor):
+            if worst is None or int(residual) > worst[0]:
+                worst = (int(residual), name)
+    if worst is None:
+        return None
+    residual, name = worst
+    return Finding(
+        rule="memory-leak-suspected",
+        severity="warn",
+        title=(
+            f"domain {name} still holds {residual} bytes after the "
+            f"operation completed"
+        ),
+        evidence={
+            "source": "report",
+            "domain": name,
+            "residual_bytes": residual,
+        },
+        remediation=(
+            "a completed operation left live bytes in a domain that "
+            "should return to baseline. Run the sentinel over the "
+            "ledger (python -m torchsnapshot_tpu.telemetry.memwatch "
+            "<path>) to see whether the residual is growing across "
+            "operations — a flat residual is retention, a growing one "
+            "is a leak in the named domain's release path."
+        ),
+    )
+
+
+def _rule_staging_pool_thrash(
+    report: Dict[str, Any]
+) -> Optional[Finding]:
+    # Windowed pool counter deltas: waits mean acquisitions blocked at
+    # the cap, and misses+waits dominating hits means the pool is too
+    # small to ever serve its purpose — every acquire allocates or
+    # stalls instead of reusing.
+    merged = _merged_memory(report)
+    pool = (merged.get("domains") or {}).get("staging_pool") or {}
+    counters = pool.get("counters") or {}
+    hits = int(counters.get("hits") or 0)
+    misses = int(counters.get("misses") or 0)
+    waits = int(counters.get("waits") or 0)
+    if waits <= 0 or misses + waits <= hits:
+        return None
+    return Finding(
+        rule="staging-pool-thrash",
+        severity="warn",
+        title=(
+            f"staging pool thrashed this operation: {waits} capacity "
+            f"wait(s), {misses} misses against {hits} hits"
+        ),
+        evidence={
+            "source": "report",
+            "hits": hits,
+            "misses": misses,
+            "waits": waits,
+            "cap_bytes": pool.get("cap_bytes"),
+            "high_water_bytes": pool.get("high_water_bytes"),
+        },
+        remediation=(
+            "restore consumers blocked on the staging-pool cap and "
+            "most acquisitions could not reuse a buffer. Raise "
+            "TPUSNAPSHOT_RESTORE_STAGING_POOL_BYTES toward the "
+            "restore's working set (watch `ops --mem` headroom), or "
+            "lower read concurrency so fewer buffers are live at once."
+        ),
+    )
+
+
+def _rule_cache_cap_misfit(report: Dict[str, Any]) -> Optional[Finding]:
+    merged = _merged_memory(report)
+    cache = (merged.get("domains") or {}).get("snapserve.cache") or {}
+    counters = dict(cache.get("counters") or {})
+    counters["cap_bytes"] = cache.get("cap_bytes")
+    counters["high_water_bytes"] = cache.get("high_water_bytes")
+    return cache_misfit_finding(counters, source="report")
+
+
 RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_consume_dominated,
     _rule_read_dominated,
@@ -1083,6 +1396,10 @@ RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = [
     _rule_fleet_degraded,
     _rule_dedup_ineffective,
     _rule_deadline_margin_collapsing,
+    _rule_host_memory_overcommit,
+    _rule_memory_leak,
+    _rule_staging_pool_thrash,
+    _rule_cache_cap_misfit,
 ]
 
 _SEVERITY_ORDER = {"critical": 0, "warn": 1}

@@ -112,6 +112,19 @@ source operation did not produce them::
                                          # total RPCs this operation put
                                          # on any transport + the worst
                                          # deadline-pressure op
+      "memory": {"domains": {"<name>": {"high_water_bytes",
+                                        "residual_bytes"?,
+                                        "cap_bytes"?}},
+                 "high_water_bytes", "headroom_bytes"?,
+                 "forecasts"?} | null,
+                                         # memwatch (snapmem) headline:
+                                         # worst per-domain window
+                                         # high-waters across ranks,
+                                         # worst-rank aggregate, minimum
+                                         # observed headroom, and total
+                                         # overcommit forecasts — the
+                                         # leak sentinel reads
+                                         # residual_bytes across records
       "durability_lag_s": null,          # ALWAYS null on take records —
                                          # the digest is written at commit,
                                          # while the ack→.tierdown window
@@ -763,6 +776,55 @@ def _wire_totals(
     return out
 
 
+def _memory_totals(
+    summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Aggregate per-rank ``memory`` blocks (memwatch windows) into the
+    digest's ``memory`` field: the worst per-domain window high-water
+    and residual across ranks, the worst-rank aggregate high-water,
+    the minimum observed headroom, and the total overcommit forecasts.
+    Residuals take the MAX across ranks — the sentinel wants the worst
+    drifter, and summing would scale the signal with world size. None
+    when no rank registered a domain."""
+    noted = [s.get("memory") for s in summaries if s and s.get("memory")]
+    if not noted:
+        return None
+    domains: Dict[str, Dict[str, Any]] = {}
+    agg_hwm = 0
+    headroom: Optional[int] = None
+    forecasts = 0
+    for block in noted:
+        for name, d in (block.get("domains") or {}).items():
+            if not isinstance(d, dict):
+                continue
+            out = domains.setdefault(name, {"high_water_bytes": 0})
+            out["high_water_bytes"] = max(
+                out["high_water_bytes"],
+                int(d.get("high_water_bytes") or 0),
+            )
+            if d.get("residual_bytes") is not None:
+                out["residual_bytes"] = max(
+                    int(out.get("residual_bytes") or 0),
+                    int(d.get("residual_bytes") or 0),
+                )
+            if d.get("cap_bytes") is not None:
+                out["cap_bytes"] = int(d["cap_bytes"])
+        agg_hwm = max(agg_hwm, int(block.get("high_water_bytes") or 0))
+        h = block.get("headroom_bytes")
+        if h is not None:
+            headroom = int(h) if headroom is None else min(headroom, int(h))
+        forecasts += len(block.get("forecasts") or [])
+    out_doc: Dict[str, Any] = {
+        "domains": domains,
+        "high_water_bytes": agg_hwm,
+    }
+    if headroom is not None:
+        out_doc["headroom_bytes"] = headroom
+    if forecasts:
+        out_doc["forecasts"] = forecasts
+    return out_doc
+
+
 def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
     """Fold a merged flight report (take or restore) into one ledger
     record. Runs the doctor over the report so the record carries the
@@ -811,6 +873,7 @@ def digest_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
         "read_plane": _read_plane_totals(summaries),
         "consume": _consume_totals(summaries),
         "wire": _wire_totals(summaries),
+        "memory": _memory_totals(summaries),
         # Null by construction at commit time (see the schema note);
         # the hot tier's drain appends a `tierdown` event record that
         # carries the closed window.

@@ -557,3 +557,39 @@ WIRE_RETRIES = (
 WIRE_BLACKBOX_DUMPS = (
     "tpusnapshot_wire_blackbox_dumps_total"  # counter {reason}
 )
+
+# Host memory plane (telemetry/memwatch.py, "snapmem"): the process-wide
+# memory-domain registry every byte-capped subsystem reconciles through.
+# `domain` is the registered domain name ("staging_pool",
+# "snapserve.cache", "scheduler.write", ...) — cardinality bounded by
+# the registry. Committed/headroom are the cross-domain headline: the
+# sum of non-external domain occupancy, and the host budget
+# (TPUSNAPSHOT_HOST_MEM_BUDGET | cgroup limit | host RAM) minus process
+# RSS. Forecast verdicts are "ok" / "overcommit" — the pre-storm check
+# that fires a doctor finding instead of an OOM.
+MEM_DOMAIN_USED = (
+    "tpusnapshot_mem_domain_used_bytes"  # gauge {domain}
+)
+MEM_DOMAIN_HWM = (
+    "tpusnapshot_mem_domain_high_water_bytes"  # gauge {domain}
+)
+MEM_DOMAIN_CAP = (
+    "tpusnapshot_mem_domain_cap_bytes"  # gauge {domain}
+)
+MEM_COMMITTED = "tpusnapshot_mem_committed_bytes"  # gauge
+MEM_HEADROOM = "tpusnapshot_mem_headroom_bytes"  # gauge
+MEM_FORECASTS = (
+    "tpusnapshot_mem_pressure_forecasts_total"  # counter {verdict}
+)
+RESTORE_POOL_LEASED = (
+    "tpusnapshot_restore_staging_pool_leased_bytes"  # gauge
+)
+RESTORE_POOL_HWM = (
+    "tpusnapshot_restore_staging_pool_high_water_bytes"  # gauge
+)
+SNAPSERVE_CACHE_BYTES = (
+    "tpusnapshot_snapserve_cache_bytes"  # gauge
+)
+SNAPSERVE_CACHE_HWM = (
+    "tpusnapshot_snapserve_cache_high_water_bytes"  # gauge
+)

@@ -44,6 +44,15 @@ renders a ``fleet wire`` section: per-member RPC totals and the
 slowest ops by p99. Exit contract: deadline misses anywhere in the
 fleet (or an unreachable member) → 1; EVERY target unreachable → 2
 (the view itself is unavailable).
+
+**Fleet memory mode** (snapmem): ``--mem`` merges the host-memory
+domain ledgers of every process in the job — trainer ranks from the
+sampler records at ``PATH``, snapserve servers (``--wire``) and
+snapwire hot-tier peers (``--wire-peers``) from the ``memory`` block
+piggybacked on their ``stats`` RPCs — into one per-domain occupancy
+view with fleet-wide sums. Exit contract: a member over a domain cap
+or past the host budget (or an unreachable member) → 1; EVERY target
+unreachable → 2.
 """
 
 import argparse
@@ -320,6 +329,235 @@ def _render_fleet_wire(fleet: Dict[str, Any]) -> List[str]:
     return lines
 
 
+# ----------------------------------------------------------- fleet memory
+
+
+def collect_fleet_mem(
+    path: Optional[str],
+    server_addrs: List[str],
+    peer_addrs: List[str],
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """snapmem's fleet-wide host-memory view: trainer ranks from the
+    sampler records at ``path``, snapserve servers and snapwire peers
+    from the ``memory`` block piggybacked on their ``stats`` RPCs.
+    Per-domain occupancy/high-water/cap SUM across members (each
+    process owns its own bytes, so the fleet total is the real host
+    footprint); the per-member blocks are kept verbatim so the
+    overcommit verdict stays per-process (one member over ITS cap is a
+    finding even when the fleet sum looks healthy). Unreachable
+    targets are recorded, not raised."""
+    members: List[Dict[str, Any]] = []
+    if path:
+        try:
+            state = collect(path)
+        except Exception as e:
+            members.append(
+                {
+                    "member": path,
+                    "kind": "trainer",
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        else:
+            for rank, rank_samples in sorted(
+                state["samples_by_rank"].items()
+            ):
+                mem = None
+                for sample in reversed(rank_samples):
+                    if isinstance(sample.get("memory"), dict):
+                        mem = sample["memory"]
+                        break
+                entry: Dict[str, Any] = {
+                    "member": f"rank {rank}",
+                    "kind": "trainer",
+                    "ok": True,
+                }
+                if mem is not None:
+                    entry["memory"] = mem
+                members.append(entry)
+    for addr in server_addrs:
+        entry = {"member": addr, "kind": "snapserve", "ok": False}
+        try:
+            from ..snapserve.server import fetch_server_stats
+
+            stats = fetch_server_stats(addr, timeout_s=timeout_s)
+            entry["ok"] = True
+            mem = stats.get("memory")
+            if isinstance(mem, dict):
+                entry["memory"] = mem
+        except Exception as e:
+            entry["error"] = f"{type(e).__name__}: {e}"
+        members.append(entry)
+    for i, addr in enumerate(peer_addrs):
+        entry = {"member": addr, "kind": "snapwire", "ok": False}
+        try:
+            from ..hottier.transport import RemotePeer
+
+            mem = RemotePeer(-(i + 1), addr).mem_stats()
+            if mem is None:
+                # Every peer process registers at least the wiretap
+                # ring domain at import, so no block means no answer.
+                raise ConnectionError("peer unreachable or down")
+            entry["ok"] = True
+            entry["memory"] = mem
+        except Exception as e:
+            entry["error"] = f"{type(e).__name__}: {e}"
+        members.append(entry)
+    domains: Dict[str, Dict[str, Any]] = {}
+    committed = 0
+    rss = 0
+    for entry in members:
+        mem = entry.get("memory")
+        if not isinstance(mem, dict):
+            continue
+        committed += int(mem.get("committed_bytes") or 0)
+        rss += int(mem.get("rss_bytes") or 0)
+        for name, block in (mem.get("domains") or {}).items():
+            if not isinstance(block, dict):
+                continue
+            agg = domains.setdefault(
+                name,
+                {
+                    "used_bytes": 0,
+                    "pinned_bytes": 0,
+                    "high_water_bytes": 0,
+                    "cap_bytes": None,
+                    "members": 0,
+                    "external": False,
+                },
+            )
+            agg["used_bytes"] += int(block.get("used_bytes") or 0)
+            agg["pinned_bytes"] += int(block.get("pinned_bytes") or 0)
+            agg["high_water_bytes"] += int(
+                block.get("high_water_bytes") or 0
+            )
+            if block.get("cap_bytes") is not None:
+                agg["cap_bytes"] = int(agg["cap_bytes"] or 0) + int(
+                    block["cap_bytes"]
+                )
+            agg["members"] += 1
+            agg["external"] = bool(
+                agg["external"] or block.get("external")
+            )
+    reachable = sum(1 for m in members if m.get("ok"))
+    return {
+        "members": members,
+        "domains": domains,
+        "committed_bytes": committed,
+        "rss_bytes": rss,
+        "reachable": reachable,
+        "unreachable": len(members) - reachable,
+    }
+
+
+def fleet_mem_findings(fleet: Dict[str, Any]) -> List[Finding]:
+    """The fleet memory verdict: unreachable members are critical (the
+    probe WAS the liveness check), and every reachable member's block
+    goes through the same overcommit rule the doctor and slo use — the
+    finding names which process is over which domain's cap."""
+    findings: List[Finding] = []
+    down = [m for m in fleet["members"] if not m.get("ok")]
+    if down:
+        findings.append(
+            Finding(
+                rule="fleet-member-unreachable",
+                severity="critical",
+                title=(
+                    f"{len(down)} of {len(fleet['members'])} fleet "
+                    f"target(s) unreachable"
+                ),
+                evidence={
+                    "unreachable": [
+                        {
+                            "member": m["member"],
+                            "kind": m["kind"],
+                            "error": m.get("error"),
+                        }
+                        for m in down
+                    ]
+                },
+                remediation=(
+                    "the stats probe could not reach these members — "
+                    "check process liveness and their flight/blackbox "
+                    "records for the last state they published."
+                ),
+            )
+        )
+    from .doctor import memory_pressure_finding
+
+    for m in fleet["members"]:
+        mem = m.get("memory")
+        if not isinstance(mem, dict):
+            continue
+        pressure = memory_pressure_finding(
+            mem, source=f"{m['kind']} {m['member']}"
+        )
+        if pressure is not None:
+            findings.append(pressure)
+    return findings
+
+
+def _render_fleet_mem(fleet: Dict[str, Any]) -> List[str]:
+    lines: List[str] = ["fleet memory:"]
+    for m in fleet["members"]:
+        if not m.get("ok"):
+            lines.append(
+                f"  {m['kind']} {m['member']}: UNREACHABLE "
+                f"({m.get('error')})"
+            )
+            continue
+        mem = m.get("memory")
+        if not isinstance(mem, dict):
+            lines.append(
+                f"  {m['kind']} {m['member']}: no memory block published"
+            )
+            continue
+        parts = [
+            f"committed {_HUMAN(mem.get('committed_bytes') or 0)}",
+            f"hwm {_HUMAN(mem.get('high_water_bytes') or 0)}",
+        ]
+        if mem.get("rss_bytes"):
+            parts.append(f"rss {_HUMAN(mem['rss_bytes'])}")
+        if mem.get("headroom_bytes") is not None:
+            parts.append(
+                f"headroom {_HUMAN(mem['headroom_bytes'])} "
+                f"(budget: {mem.get('budget_source', '?')})"
+            )
+        lines.append(f"  {m['kind']} {m['member']}: " + ", ".join(parts))
+        for name, d in sorted((mem.get("domains") or {}).items()):
+            cap = d.get("cap_bytes")
+            lines.append(
+                f"    {name}: {_HUMAN(d.get('used_bytes') or 0)}"
+                + (f" / {_HUMAN(cap)}" if cap is not None else "")
+                + f" (hwm {_HUMAN(d.get('high_water_bytes') or 0)})"
+                + (" [external]" if d.get("external") else "")
+            )
+    if fleet["domains"]:
+        lines.append("  merged domains (fleet-wide sums):")
+        by_used = sorted(
+            fleet["domains"].items(),
+            key=lambda kv: int(kv[1].get("used_bytes") or 0),
+            reverse=True,
+        )
+        for name, d in by_used:
+            cap = d.get("cap_bytes")
+            lines.append(
+                f"    {name}: used {_HUMAN(d['used_bytes'])}"
+                + (f" / {_HUMAN(cap)}" if cap is not None else "")
+                + f", hwm {_HUMAN(d['high_water_bytes'])} across "
+                f"{d['members']} member(s)"
+                + (" [external]" if d.get("external") else "")
+            )
+        lines.append(
+            f"  fleet committed {_HUMAN(fleet['committed_bytes'])}, "
+            f"rss {_HUMAN(fleet['rss_bytes'])} over "
+            f"{fleet['reachable']} reachable member(s)"
+        )
+    return lines
+
+
 # -------------------------------------------------------------- rendering
 
 
@@ -496,6 +734,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "addresses (host=addr entries also accepted) to poll",
     )
     parser.add_argument(
+        "--mem",
+        action="store_true",
+        help="fleet memory mode (snapmem): merge the host-memory "
+        "domain ledgers of trainer ranks (from PATH's sampler "
+        "records), snapserve servers (--wire) and snapwire peers "
+        "(--wire-peers) into one per-domain occupancy view",
+    )
+    parser.add_argument(
         "--wire-timeout",
         type=float,
         default=10.0,
@@ -526,6 +772,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     wire_mode = bool(args.wire or args.wire_peers)
     if not args.path and not wire_mode:
         parser.error("a path is required (or --wire / --wire-peers)")
+    if args.mem:
+        server_addrs = [
+            a.strip() for a in (args.wire or "").split(",") if a.strip()
+        ]
+        peer_addrs = [
+            a.strip().rpartition("=")[2]
+            for a in (args.wire_peers or "").split(",")
+            if a.strip()
+        ]
+        fleet = collect_fleet_mem(
+            args.path,
+            server_addrs,
+            peer_addrs,
+            timeout_s=args.wire_timeout,
+        )
+        mem_findings = fleet_mem_findings(fleet)
+        if args.json:
+            doc = dict(
+                fleet, findings=[f.as_dict() for f in mem_findings]
+            )
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print("\n".join(_render_fleet_mem(fleet)))
+            print()
+            print(render_findings(mem_findings))
+        if fleet["members"] and fleet["reachable"] == 0:
+            return 2  # the fleet memory view itself is unavailable
+        return (
+            1
+            if any(f.severity == "critical" for f in mem_findings)
+            else 0
+        )
     if wire_mode:
         server_addrs = [
             a.strip() for a in (args.wire or "").split(",") if a.strip()

@@ -142,6 +142,16 @@ class FlightRecorder:
             self._wire_token = wiretap.window_begin()
         except Exception:
             logger.debug("wire window open failed", exc_info=True)
+        # Host-memory window (memwatch/snapmem): phase-windowed
+        # per-domain high-waters for this operation's ``memory`` block.
+        # Same contract: best-effort, absent when nothing registered.
+        self._mem_token: Any = None
+        try:
+            from torchsnapshot_tpu.telemetry import memwatch
+
+            self._mem_token = memwatch.window_begin()
+        except Exception:
+            logger.debug("memory window open failed", exc_info=True)
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -263,6 +273,22 @@ class FlightRecorder:
                 wire_block = None
             if wire_block:
                 summary["wire"] = wire_block
+        if self._mem_token is not None:
+            # Close the memory window: per-domain high-waters inside
+            # this operation, ending occupancy/residuals, counter
+            # deltas, and any pressure forecasts — what the
+            # host-memory doctor rules, the leak sentinel, and the
+            # ledger's memory field read. Absent when no domain was
+            # registered.
+            try:
+                from torchsnapshot_tpu.telemetry import memwatch
+
+                mem_block = memwatch.window_collect(self._mem_token)
+            except Exception:
+                logger.debug("memory window collect failed", exc_info=True)
+                mem_block = None
+            if mem_block:
+                summary["memory"] = mem_block
         # Goodput attribution at summary time (present only once the
         # accountant saw a train loop or a checkpoint wait): the doctor's
         # checkpoint-overhead-above-budget rule and the ledger's goodput

@@ -180,6 +180,16 @@ class RuntimeSampler:
         wire = wiretap.sample_block()
         if wire.get("ops"):
             sample["wire"] = wire
+        # Host memory plane (memwatch/snapmem): the cross-domain
+        # occupancy table + headroom headline — the slo live rule
+        # tracks residual drift and overcommit across samples; absent
+        # when no domain is registered. Includes the staging pool's
+        # retained/leased/high-water split via its domain entry.
+        from . import memwatch
+
+        mem = memwatch.sample_block()
+        if mem.get("domains"):
+            sample["memory"] = mem
         return sample
 
     def sample_once(self) -> Optional[Dict[str, Any]]:

@@ -55,8 +55,9 @@ import sys
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..utils.env import env_float
-from .doctor import Finding, wire_pressure_finding
+from ..utils.env import env_float, env_int
+from .doctor import Finding, memory_pressure_finding, wire_pressure_finding
+from .memwatch import LEAK_MIN_BYTES_ENV_VAR as _MEM_LEAK_MIN_BYTES_ENV_VAR
 
 # The dotted-field numeric getter lives in timeline; re-implementing it
 # here would be the package's third copy.
@@ -575,6 +576,83 @@ def rule_wire_deadline_pressure(
     return wire_pressure_finding(ops, source="live")
 
 
+def rule_memory_pressure(
+    samples: List[Dict[str, Any]],
+) -> Optional[Finding]:
+    """snapmem: the memwatch sample block shows host memory in trouble
+    — live overcommit on the latest sample (a domain past its cap, or
+    committed bytes past the host budget — verdict shared with the
+    doctor's ``host-memory-overcommit`` rule via
+    :func:`~.doctor.memory_pressure_finding`), or a residual-watched
+    domain's bytes growing monotonically across the window
+    (``memory-leak-suspected`` — occupancy in the sampler is a
+    point-in-time reading, so the trend needs 3+ memory-bearing
+    samples to speak)."""
+    memed = [
+        s["memory"]
+        for s in samples
+        if isinstance(s.get("memory"), dict) and s["memory"].get("domains")
+    ]
+    if not memed:
+        return None
+    latest = memed[-1]
+    finding = memory_pressure_finding(latest, source="live")
+    if finding is not None:
+        return finding
+    if len(memed) < 3:
+        return None
+    floor = env_int(_MEM_LEAK_MIN_BYTES_ENV_VAR, 1 << 20)
+    worst: Optional[Tuple[int, int, str, List[int]]] = None
+    for name in latest.get("domains") or {}:
+        series: List[int] = []
+        for mem in memed:
+            d = (mem.get("domains") or {}).get(name)
+            if not isinstance(d, dict):
+                series = []
+                break
+            watch = d.get("watch_residual")
+            if watch == "pinned":
+                series.append(int(d.get("pinned_bytes") or 0))
+            elif watch == "used":
+                series.append(int(d.get("used_bytes") or 0))
+            else:
+                series = []
+                break
+        if len(series) < 3:
+            continue
+        growth = series[-1] - series[0]
+        monotonic = all(b >= a for a, b in zip(series, series[1:]))
+        if monotonic and growth >= max(1, floor) and series[-1] > 0:
+            if worst is None or growth > worst[0]:
+                worst = (growth, series[-1], name, series)
+    if worst is None:
+        return None
+    growth, current, name, series = worst
+    return Finding(
+        rule="memory-leak-suspected",
+        severity="warn",
+        title=(
+            f"domain {name} grew {growth} bytes across the sampler "
+            f"window without ever shrinking (now {current} bytes)"
+        ),
+        evidence={
+            "source": "live",
+            "domain": name,
+            "growth_bytes": growth,
+            "current_bytes": current,
+            "samples": len(series),
+            "series_tail": series[-8:],
+        },
+        remediation=(
+            "bytes the named domain should release between operations "
+            "are only ever growing. Cross-check the ledger sentinel "
+            "(python -m torchsnapshot_tpu.telemetry.memwatch <path>) "
+            "for the per-operation residual trend, and inspect the "
+            "domain's lease/charge call sites."
+        ),
+    )
+
+
 def evaluate_live(
     samples: List[Dict[str, Any]],
     budget_s: Optional[float] = None,
@@ -592,6 +670,7 @@ def evaluate_live(
             rule_durability_lag_live(samples, budget_s=budget_s),
             rule_replication_underreplicated(samples),
             rule_wire_deadline_pressure(samples),
+            rule_memory_pressure(samples),
         )
         if f is not None
     ]
@@ -887,6 +966,55 @@ def _self_test() -> int:
     assert not any(
         f.rule == "deadline-margin-collapsing" for f in idle
     ), idle
+    # snapmem: host-memory pressure + leak drift over the sampler's
+    # memory block.
+    def mem_sample(used, cap=1 << 20, hwm=None, budget=1 << 30):
+        return {
+            "memory": {
+                "domains": {
+                    "t.pool": {
+                        "used_bytes": used,
+                        "pinned_bytes": used,
+                        "cap_bytes": cap,
+                        "high_water_bytes": (
+                            hwm if hwm is not None else used
+                        ),
+                        "watch_residual": "pinned",
+                    }
+                },
+                "committed_bytes": used,
+                "high_water_bytes": hwm if hwm is not None else used,
+                "budget_bytes": budget,
+                "headroom_bytes": budget - used,
+            }
+        }
+
+    healthy_mem = evaluate_live([mem_sample(1000)])
+    assert not any(
+        f.rule in ("host-memory-overcommit", "memory-leak-suspected")
+        for f in healthy_mem
+    ), healthy_mem
+    # A domain's high-water past its cap: critical on the latest sample
+    # (this is what a faultline mem_pressure cap-shrink trips).
+    over_cap = evaluate_live([mem_sample(900, cap=512, hwm=900)])
+    assert any(
+        f.rule == "host-memory-overcommit" and f.severity == "critical"
+        for f in over_cap
+    ), over_cap
+    # Monotonic growth of a residual-watched domain across 3+ samples.
+    leak = evaluate_live(
+        [mem_sample(0), mem_sample(2 << 20, cap=8 << 20),
+         mem_sample(5 << 20, cap=8 << 20)]
+    )
+    leak = [f for f in leak if f.rule == "memory-leak-suspected"]
+    assert leak and leak[0].evidence["domain"] == "t.pool", leak
+    # Growth that comes back down is churn, not a leak.
+    churn = evaluate_live(
+        [mem_sample(0), mem_sample(5 << 20, cap=8 << 20), mem_sample(0)]
+    )
+    assert not any(
+        f.rule == "memory-leak-suspected" for f in churn
+    ), churn
     print("slo self-test OK")
     return 0
 

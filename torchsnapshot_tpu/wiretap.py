@@ -50,6 +50,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from . import tracing
+from .telemetry import memwatch
 from .telemetry.metrics import (
     REGISTRY,
     WIRE_BLACKBOX_DUMPS,
@@ -173,6 +174,23 @@ _RING: Deque[Dict[str, Any]] = deque(maxlen=env_int(_RING_ENV_VAR, _DEFAULT_RING
 _ATEXIT_REGISTERED = False
 _LAST_DUMP: Dict[str, float] = {}
 
+# snapmem: the flight-recorder ring is a real (if small) RAM consumer —
+# a few hundred event dicts. Report it as a polled domain with a fixed
+# per-event estimate; the point is the registry's completeness (every
+# byte-capped structure visible in one table), not byte-exact dict
+# sizing. Evictable: the ring drops its tail by design.
+_RING_EVENT_EST_BYTES = 512
+
+
+def _mem_provider() -> Tuple[int, int, Optional[int]]:
+    with _LOCK:
+        used = len(_RING) * _RING_EVENT_EST_BYTES
+        cap = (_RING.maxlen or 0) * _RING_EVENT_EST_BYTES
+    return used, 0, cap
+
+
+memwatch.register_provider("wiretap.ring", _mem_provider)
+
 
 def reset() -> None:
     """Drop all aggregates and ring contents; re-read the ring size
@@ -182,6 +200,7 @@ def reset() -> None:
         _AGG.clear()
         _LAST_DUMP.clear()
         _RING = deque(maxlen=env_int(_RING_ENV_VAR, _DEFAULT_RING))
+    memwatch.register_provider("wiretap.ring", _mem_provider)
 
 
 def _register_atexit() -> None:
